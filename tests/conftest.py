@@ -1,0 +1,19 @@
+"""Test configuration.
+
+Must run before any ``jax`` import: forces an 8-device virtual CPU
+platform so multi-chip sharding (``jax.sharding.Mesh`` + ``shard_map``)
+is exercised without TPU hardware, per the driver contract.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+from hypothesis import settings  # noqa: E402
+
+settings.register_profile("ci", max_examples=25, deadline=None)
+settings.load_profile("ci")

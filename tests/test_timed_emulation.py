@@ -1,0 +1,336 @@
+"""MonadTimed property suite against the pure emulator.
+
+Port of `/root/reference/test/Test/Control/TimeWarp/Timed/MonadTimedSpec.hs`
+(the ``TimedT`` half; the real-mode half runs in test_timed_realtime.py).
+Random times are bounded to 10 minutes like the reference's Arbitrary
+instance (test/Test/Control/TimeWarp/Common.hs:27-29).
+"""
+
+from hypothesis import given
+from hypothesis import strategies as st
+
+from timewarp_tpu import (PureEmulation, ThreadKilled, TimeoutExpired, after,
+                          at, for_, fork, fork_, invoke, kill_thread, now,
+                          run_emulation, schedule, sec, timeout, virtual_time,
+                          wait)
+from timewarp_tpu.core.effects import Fork, GetTime, Wait
+
+MAX_T = 10 * 60 * 1_000_000  # 10 minutes in µs (Common.hs:28-29)
+times = st.integers(min_value=0, max_value=MAX_T)
+vals = st.integers(min_value=-1000, max_value=1000)
+funs = st.functions(like=lambda x: x, returns=vals, pure=True)
+
+
+# --- virtualTime >> virtualTime (MonadTimedSpec.hs:326-328) -------------
+
+def test_virtual_time_monotone():
+    def prog():
+        t1 = yield GetTime()
+        t2 = yield GetTime()
+        assert t1 <= t2
+        return t2
+
+    assert run_emulation(prog) == 0  # no wait => no time passes
+
+
+# --- wait t waits at least t (MonadTimedSpec.hs:320-324) ----------------
+
+@given(t=times)
+def test_wait_passes_at_least_t(t):
+    out = {}
+
+    def prog():
+        t1 = yield GetTime()
+        yield Wait(for_(t))
+        t2 = yield GetTime()
+        out["ok"] = t1 + t <= t2
+
+    run_emulation(prog)
+    assert out["ok"]
+
+
+# --- fork does not change action semantics (MonadTimedSpec.hs:314-318) --
+
+@given(v=vals, f=funs)
+def test_fork_preserves_semantics(v, f):
+    expected = f(v)
+    out = {}
+
+    def child():
+        out["res"] = f(v)
+        return None
+        yield  # make it a generator
+
+    def prog():
+        yield Fork(child)
+        yield Wait(for_(sec(1)))
+
+    run_emulation(prog)
+    assert out["res"] == expected
+
+
+# --- schedule/invoke: semantics preserved + not before the spec ---------
+# (MonadTimedSpec.hs:288-312)
+
+@given(rel=times, v=vals, f=funs)
+def test_schedule_semantics_and_time(rel, v, f):
+    expected = f(v)
+    out = {}
+
+    def action():
+        out["t"] = yield GetTime()
+        out["res"] = f(v)
+
+    def prog():
+        t1 = yield GetTime()
+        out["t1"] = t1
+        yield from schedule(after(rel), action)
+        yield Wait(for_(rel + sec(1)))
+
+    run_emulation(prog)
+    assert out["res"] == expected
+    assert out["t1"] + rel <= out["t"]
+
+
+@given(rel=times, v=vals, f=funs)
+def test_invoke_semantics_and_time(rel, v, f):
+    expected = f(v)
+    out = {}
+
+    def action():
+        out["t"] = yield GetTime()
+        return f(v)
+
+    def prog():
+        t1 = yield GetTime()
+        res = yield from invoke(after(rel), action)
+        out["res"] = res
+        out["t1"] = t1
+
+    run_emulation(prog)
+    assert out["res"] == expected
+    assert out["t1"] + rel <= out["t"]
+
+
+# --- now is exact under invoke (nowProp, MonadTimedSpec.hs:349-355) -----
+
+@given(t=times)
+def test_invoke_now_is_instant(t):
+    def prog():
+        yield Wait(for_(t))
+        t1 = yield GetTime()
+        yield from invoke(now, _noop)
+        t2 = yield GetTime()
+        assert t1 == t2 == t
+
+    run_emulation(prog)
+
+
+def _noop():
+    return None
+    yield
+
+
+# --- absolute time specs ------------------------------------------------
+
+@given(t1=times, t2=times)
+def test_till_is_absolute(t1, t2):
+    """wait(for 1s) >> wait(till 5s) lands at 5s (MonadTimed.hs:119-124)."""
+    def prog():
+        yield Wait(for_(t1))
+        yield Wait(at(t2))
+        cur = yield GetTime()
+        assert cur == max(t1, t2)  # till clamps to now (TimedT.hs:349)
+
+    run_emulation(prog)
+
+
+# --- timeout (timeoutTimedProp, MonadTimedSpec.hs:275-286) --------------
+
+@given(tout=times, wt=times)
+def test_timeout_boundary(tout, wt):
+    def action():
+        yield Wait(for_(wt))
+        return wt <= tout
+
+    def prog():
+        try:
+            res = yield from timeout(tout, action)
+        except TimeoutExpired:
+            res = tout <= wt
+        return res
+
+    assert run_emulation(prog) is True
+
+
+def test_timeout_deterministic_boundary():
+    """Exact boundary: body finishing strictly inside the deadline never
+    times out; at or past the (inclusive) deadline it always does."""
+    def make(tout, wt):
+        def action():
+            yield Wait(for_(wt))
+            return "done"
+
+        def prog():
+            try:
+                return (yield from timeout(tout, action))
+            except TimeoutExpired:
+                return "timeout"
+        return prog
+
+    for tout, wt in [(1, 0), (5, 4), (2, 1)]:
+        assert run_emulation(make(tout, wt)) == "done", (tout, wt)
+    for tout, wt in [(5, 5), (5, 6), (0, 0)]:
+        assert run_emulation(make(tout, wt)) == "timeout", (tout, wt)
+
+
+# --- killThread (killThreadTimedProp, MonadTimedSpec.hs:246-273) --------
+
+@given(m=times, f1=times, f2=times)
+def test_kill_thread_three_way(m, f1, f2):
+    var = [0]
+
+    def inner():  # this thread is not killed
+        yield Wait(for_(f1))
+        var[0] = 1
+
+    def victim():
+        yield Fork(inner)
+        yield Wait(for_(f2))
+        var[0] = 2
+
+    def prog():
+        tid = yield from fork(victim)
+        yield Wait(for_(m))
+        yield from kill_thread(tid)
+        yield Wait(for_(f1))
+        yield Wait(for_(f2))
+
+    run_emulation(prog)
+    res = var[0]
+    if res == 0:
+        assert m <= f1 and m <= f2
+    elif res == 2:
+        assert f2 <= m
+    else:
+        assert res == 1  # inner thread can never be killed
+
+
+# --- exception props (MonadTimedSpec.hs:369-403) ------------------------
+
+class _TestExc(Exception):
+    pass
+
+
+def test_exceptions_thrown():
+    flag = [True]
+
+    def prog():
+        try:
+            raise _TestExc()
+            flag[0] = False  # noqa: unreachable — mirrors `put False`
+        except Exception:
+            pass
+
+    run_emulation(prog)
+    assert flag[0]
+
+
+def test_exceptions_caught():
+    flag = [None]
+
+    def prog():
+        try:
+            flag[0] = False
+            raise _TestExc()
+        except _TestExc:
+            flag[0] = True
+
+    run_emulation(prog)
+    assert flag[0] is True
+
+
+def test_exceptions_wait_throw_caught():
+    flag = [None]
+
+    def prog():
+        try:
+            flag[0] = False
+            yield Wait(for_(sec(1)))
+            raise _TestExc()
+        except _TestExc:
+            flag[0] = True
+
+    run_emulation(prog)
+    assert flag[0] is True
+
+
+def test_exception_not_affect_main_thread():
+    """exceptionNotAffectMainThread (MonadTimedSpec.hs:391-396)."""
+    flag = [None]
+
+    def thrower():
+        raise _TestExc()
+        yield
+
+    def prog():
+        flag[0] = False
+        yield Fork(thrower)
+        yield Wait(for_(sec(1)))
+        flag[0] = True
+
+    run_emulation(prog)
+    assert flag[0] is True
+
+
+def test_exception_not_affect_other_thread():
+    """exceptionNotAffectOtherThread (MonadTimedSpec.hs:398-403)."""
+    flag = [None]
+
+    def setter():
+        flag[0] = True
+        return None
+        yield
+
+    def thrower():
+        raise _TestExc()
+        yield
+
+    def prog():
+        flag[0] = False
+        yield from schedule(after(sec(3)), setter)
+        yield from schedule(after(sec(1)), thrower)
+        yield Wait(for_(sec(5)))
+
+    run_emulation(prog)
+    assert flag[0] is True
+
+
+# --- start_timer (MonadTimed.hs:301-318 doc example) --------------------
+
+def test_start_timer():
+    from timewarp_tpu import ms, start_timer
+
+    def prog():
+        yield Wait(for_(sec(10)))
+        timer = yield from start_timer()
+        yield Wait(for_(ms(5)))
+        passed = yield from timer()
+        assert passed == ms(5)
+
+    run_emulation(prog)
+
+
+# --- the canonical two-mode doc example (Timed.hs:14-40) ----------------
+
+def test_wait_costs_zero_wallclock():
+    import time as _wall
+
+    def prog():
+        yield Wait(for_(600 * 1_000_000))  # 10 virtual minutes
+        return (yield GetTime())
+
+    t0 = _wall.monotonic()
+    result = run_emulation(prog)
+    assert result == 600 * 1_000_000
+    assert _wall.monotonic() - t0 < 1.0  # instant in wall-clock

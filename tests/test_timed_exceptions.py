@@ -1,0 +1,379 @@
+"""Exception-ordering semantics under the pure emulator.
+
+Port of `/root/reference/test/Test/Control/TimeWarp/Timed/ExceptionSpec.hs`
+including the checkpoint fixture (ExceptionSpec.hs:253-287): checkpoints
+must be visited in order 1, 2, 3…; visiting -1 is always a failure.
+
+Also revives the two tests the reference stubbed out as FIXME
+(ExceptionSpec.hs:68-100) — their intended semantics are well-defined
+(uncaught fork exceptions abort only their own thread, TimedT.hs:153-158)
+and the new framework passes them.
+"""
+
+import pytest
+
+from timewarp_tpu import (PureEmulation, ThreadKilled, after, at, for_,
+                          fork, invoke, kill_thread, run_emulation, schedule,
+                          sec, wait)
+from timewarp_tpu.core.effects import Fork, GetTime, ThrowTo, Wait
+
+
+class CheckPoints:
+    """≙ ExceptionSpec.hs:256-287."""
+
+    def __init__(self):
+        self.state = 0  # int = last visited; str = error
+
+    def visit(self, cur):
+        if isinstance(self.state, str):
+            return
+        if self.state == cur - 1:
+            self.state = cur
+        else:
+            self.state = f"Wrong checkpoint. Expected {self.state + 1}, visited {cur}"
+
+    def assert_ok(self, last=None):
+        assert not isinstance(self.state, str), self.state
+        if last is not None:
+            assert self.state == last
+
+
+class _ArithExc(ArithmeticError):
+    pass
+
+
+def test_exc_caught():
+    """excCaught (ExceptionSpec.hs:102-109)."""
+    cp = CheckPoints()
+
+    def prog():
+        try:
+            raise ThreadKilled()
+            cp.visit(-1)
+        except Exception:
+            cp.visit(1)
+        return None
+        yield
+
+    run_emulation(prog)
+    cp.assert_ok(1)
+
+
+def test_exc_caught_outside():
+    """excCaughtOutside (ExceptionSpec.hs:111-121): main-thread exception
+    propagates out of the emulator after a wait."""
+    cp = CheckPoints()
+
+    def prog():
+        yield Wait(for_(sec(1)))
+        raise ThreadKilled()
+
+    try:
+        run_emulation(prog)
+        cp.visit(-1)
+    except ThreadKilled:
+        cp.visit(1)
+    cp.visit(2)
+    cp.assert_ok(2)
+
+
+def test_exc_caught_outside_no_wait():
+    """excCaughtOutsideWithWait (ExceptionSpec.hs:123-133)."""
+    cp = CheckPoints()
+
+    def prog():
+        raise ThreadKilled()
+        yield
+
+    try:
+        run_emulation(prog)
+        cp.visit(-1)
+    except ThreadKilled:
+        cp.visit(1)
+    cp.visit(2)
+    cp.assert_ok(2)
+
+
+def test_exc_wait_throw():
+    """excWaitThrow (ExceptionSpec.hs:135-146): catch survives a wait."""
+    cp = CheckPoints()
+
+    def prog():
+        try:
+            yield Wait(for_(sec(1)))
+            raise ThreadKilled()
+        except Exception:
+            cp.visit(1)
+        cp.visit(2)
+
+    run_emulation(prog)
+    cp.assert_ok(2)
+
+
+def test_exc_wait_throw_forked():
+    """excWaitThrowForked (ExceptionSpec.hs:148-159)."""
+    cp = CheckPoints()
+
+    def child():
+        try:
+            yield Wait(for_(sec(1)))
+            raise ThreadKilled()
+        except Exception:
+            cp.visit(1)
+
+    def prog():
+        yield Fork(child)
+        yield from invoke(after(sec(1)), _visit(cp, 2))
+
+    run_emulation(prog)
+    cp.assert_ok(2)
+
+
+def _visit(cp, k):
+    def p():
+        cp.visit(k)
+        return None
+        yield
+    return p
+
+
+def test_exc_catch_order():
+    """excCatchOrder (ExceptionSpec.hs:161-171): inner handler wins."""
+    cp = CheckPoints()
+
+    def prog():
+        try:
+            try:
+                raise ThreadKilled()
+            except Exception:
+                cp.visit(1)
+        except Exception:
+            cp.visit(-1)
+        cp.visit(2)
+        return None
+        yield
+
+    run_emulation(prog)
+    cp.assert_ok(2)
+
+
+def test_exc_catch_scope():
+    """excCatchScope (ExceptionSpec.hs:173-182): a finished catch block
+    does not handle future exceptions."""
+    cp = CheckPoints()
+
+    def prog():
+        try:
+            try:
+                cp.visit(1)
+            except Exception:
+                cp.visit(-1)
+            raise ThreadKilled()
+        except Exception:
+            cp.visit(2)
+        cp.visit(3)
+        return None
+        yield
+
+    run_emulation(prog)
+    cp.assert_ok(3)
+
+
+def test_exc_catch_scope_with_wait():
+    """excCatchScopeWithWait (ExceptionSpec.hs:184-193)."""
+    cp = CheckPoints()
+
+    def prog():
+        try:
+            try:
+                cp.visit(1)
+                yield Wait(for_(sec(1)))
+            except Exception:
+                cp.visit(-1)
+            yield Wait(for_(sec(1)))
+            raise ThreadKilled()
+        except Exception:
+            cp.visit(2)
+        cp.visit(3)
+
+    run_emulation(prog)
+    cp.assert_ok(3)
+
+
+def test_exc_diff_catch_inner():
+    """excDiffCatchInner (ExceptionSpec.hs:195-204): typed handler match."""
+    cp = CheckPoints()
+
+    def prog():
+        try:
+            try:
+                raise ThreadKilled()
+            except ThreadKilled:
+                cp.visit(1)
+            except ArithmeticError:
+                cp.visit(-1)
+        except Exception:
+            cp.visit(-1)
+        cp.visit(2)
+        return None
+        yield
+
+    run_emulation(prog)
+    cp.assert_ok(2)
+
+
+def test_exc_diff_catch_outer():
+    """excDiffCatchOuter (ExceptionSpec.hs:207-217)."""
+    cp = CheckPoints()
+
+    def prog():
+        try:
+            try:
+                raise _ArithExc()
+            except ThreadKilled:
+                cp.visit(-1)
+        except ArithmeticError:
+            cp.visit(1)
+        cp.visit(2)
+        return None
+        yield
+
+    run_emulation(prog)
+    cp.assert_ok(2)
+
+
+def test_handler_throw():
+    """handlerThrow (ExceptionSpec.hs:219-229): an exception raised by a
+    handler propagates to the outer handler."""
+    cp = CheckPoints()
+
+    def prog():
+        try:
+            try:
+                raise ThreadKilled()
+            except Exception:
+                raise _ArithExc()
+        except ArithmeticError:
+            cp.visit(1)
+        cp.visit(2)
+        return None
+        yield
+
+    run_emulation(prog)
+    cp.assert_ok(2)
+
+
+def test_throw_to_throws_correct_exception():
+    """throwToThrowsCorrectException (ExceptionSpec.hs:231-242)."""
+    cp = CheckPoints()
+
+    def child():
+        try:
+            yield Wait(for_(sec(1)))
+        except ArithmeticError:
+            cp.visit(1)
+
+    def prog():
+        tid = yield from fork(child)
+        yield ThrowTo(tid, _ArithExc())
+        yield Wait(for_(sec(2)))
+        cp.visit(2)
+
+    run_emulation(prog)
+    cp.assert_ok(2)
+
+
+def test_throw_to_can_kill_thread():
+    """throwToCanKillThread (ExceptionSpec.hs:244-251)."""
+    cp = CheckPoints()
+
+    def child():
+        yield Wait(for_(sec(1)))
+        cp.visit(-1)
+
+    def prog():
+        tid = yield from fork(child)
+        yield ThrowTo(tid, _ArithExc())
+
+    run_emulation(prog)
+    cp.assert_ok(0)
+
+
+def test_throw_to_first_exception_wins():
+    """TimedT.hs:359 — the queued async exception is not overwritten."""
+    seen = []
+
+    def child():
+        try:
+            yield Wait(for_(sec(1)))
+        except Exception as e:
+            seen.append(type(e).__name__)
+
+    def prog():
+        tid = yield from fork(child)
+        yield ThrowTo(tid, _ArithExc())
+        yield ThrowTo(tid, ThreadKilled())
+
+    run_emulation(prog)
+    assert seen == ["_ArithExc"]
+
+
+def test_exception_aborts_own_thread():
+    """exceptionShouldAbortExecution — the FIXME'd test
+    (ExceptionSpec.hs:69-82), revived with its intended semantics."""
+    var = [0]
+
+    def child():
+        var[0] = 1
+        yield Wait(for_(sec(1)))
+        raise _ArithExc()
+        var[0] = 2
+
+    def prog():
+        yield Fork(child)
+        yield Wait(for_(sec(2)))
+
+    run_emulation(prog)
+    assert var[0] == 1
+
+
+def test_async_exception_does_not_abort_others():
+    """asyncExceptionShouldntAbortExecution — the second FIXME'd test
+    (ExceptionSpec.hs:85-100), revived."""
+    var = [0]
+
+    def thrower():
+        yield Wait(for_(sec(1)))
+        raise _ArithExc()
+
+    def prog():
+        var[0] = 1
+        yield Fork(thrower)
+        yield Wait(for_(sec(2)))
+        var[0] = 2
+
+    run_emulation(prog)
+    assert var[0] == 2
+
+
+def test_kill_thread_preempts_sleeping_thread():
+    """killThread pre-empts a sleeping thread *now*, not at its wake time
+    (wakeUpThread, TimedT.hs:357-368)."""
+    log = []
+
+    def sleeper():
+        try:
+            yield Wait(for_(sec(100)))
+            log.append("woke")
+        except ThreadKilled:
+            log.append((yield GetTime()))
+            raise
+
+    def prog():
+        tid = yield from fork(sleeper)
+        yield Wait(for_(sec(1)))
+        yield from kill_thread(tid)
+
+    run_emulation(prog)
+    # killed at 1s + 1µs of fork handoff, not at 100s
+    assert log == [sec(1) + 1]

@@ -1,0 +1,240 @@
+"""Pure deterministic discrete-event emulation — the framework's oracle.
+
+TPU-native re-design of the reference's ``TimedT``
+(`/root/reference/src/Control/TimeWarp/Timed/TimedT.hs`). The whole
+multi-thread scenario executes on one host thread; ``wait`` costs zero
+wall-clock; every action between waits is 0-cost in virtual time
+(TimedT.hs:139-145). This interpreter is the *semantic reference* that
+the batched JAX engine must match trace-for-trace (SURVEY.md §7).
+
+Where the reference captures continuations with ``ContT`` (TimedT.hs:
+146-151, 343-355), we use Python generators: a suspended thread *is* its
+generator frame, and the event queue holds resume thunks. Exception
+handler stacks with re-arming after each wait (the reference's
+``catchesSeq``/``ContException`` machinery, TimedT.hs:178-204, 259-284)
+are subsumed by the language: throwing into a generator at its
+suspension point runs the program's own ``try/except`` blocks with
+exactly the scoping the reference had to build by hand.
+
+Determinism contract (explicit where the reference leaned on heap
+internals, TimedT.hs:100-104; SURVEY.md §5.2): events are totally
+ordered by ``(virtual_time, seq)`` where ``seq`` is a monotone insertion
+counter. Equal-time events therefore run in the order they were
+scheduled, and a ``throw_to`` wake-up reschedules the target with a
+fresh ``seq`` (it runs after events already queued at `now`).
+"""
+
+from __future__ import annotations
+
+import heapq
+import logging
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional
+
+from ...core.effects import (Effect, Fork, GetLogName, GetTime, MyTid,
+                             Program, ProgramFn, SetLogName, ThrowTo, Wait)
+from ...core.errors import ThreadKilled
+from ...core.time import Microsecond, resolve
+
+__all__ = ["PureEmulation", "PureThreadId", "run_emulation"]
+
+_log = logging.getLogger("timewarp.emulation")
+
+
+@dataclass(frozen=True)
+class PureThreadId:
+    """≙ ``PureThreadId`` (TimedT.hs:72-76)."""
+    n: int
+
+    def __repr__(self) -> str:  # pragma: no cover - debug aid
+        return f"PureThreadId({self.n})"
+
+
+@dataclass
+class _Thread:
+    tid: PureThreadId
+    gen: Optional[Program]       # None until the start event fires
+    program: Optional[ProgramFn]
+    is_main: bool
+    log_name: str
+    alive: bool = True
+    started: bool = False
+    resume_entry: Optional[list] = None  # live queue entry, for wake-ups
+
+
+# Queue entry layout: [time, seq, tid, send_value, cancelled]
+_TIME, _SEQ, _TID, _VALUE, _CANCELLED = range(5)
+
+
+class PureEmulation:
+    """Deterministic emulation interpreter (≙ ``runTimedT``, TimedT.hs:293-304).
+
+    ``run(program_fn)`` executes the scenario to quiescence (event queue
+    empty, TimedT.hs:266-267) and returns the main program's result; an
+    exception escaping the *main* thread propagates to the caller, while
+    uncaught exceptions in forked threads are logged — ``ThreadKilled``
+    at DEBUG, others at WARNING (TimedT.hs:153-158, 306-316).
+    """
+
+    def __init__(self, *, default_log_name: str = "emulation") -> None:
+        # ≙ defaultLoggerName (TimedT.hs:380-381)
+        self._default_log_name = default_log_name
+        self._queue: List[list] = []
+        self._threads: Dict[PureThreadId, _Thread] = {}
+        self._pending_exc: Dict[PureThreadId, BaseException] = {}
+        self._time: Microsecond = 0
+        self._seq = 0
+        self._tid_counter = 0  # ≙ threadsCounter (TimedT.hs:114-115)
+
+    # -- public ----------------------------------------------------------
+
+    @property
+    def virtual_time(self) -> Microsecond:
+        return self._time
+
+    def run(self, program_fn: ProgramFn) -> Any:
+        main = self._spawn(program_fn, self._default_log_name, is_main=True)
+        self._push(main, self._time, None)
+        main_result: List[Any] = []
+        main_error: List[BaseException] = []
+
+        # Event loop ≙ launchTimedT (TimedT.hs:234-286).
+        while self._queue:
+            entry = heapq.heappop(self._queue)
+            if entry[_CANCELLED]:
+                continue
+            th = self._threads[entry[_TID]]
+            th.resume_entry = None
+            if not th.alive:
+                continue
+            # Rewind the clock to the event's instant (TimedT.hs:247).
+            self._time = entry[_TIME]
+            # Deliver a pending async exception, if any (TimedT.hs:252-257).
+            exc = self._pending_exc.pop(th.tid, None)
+            self._step(th, entry[_VALUE], exc, main_result, main_error)
+
+        if main_error:
+            raise main_error[0]
+        return main_result[0] if main_result else None
+
+    # -- scheduling ------------------------------------------------------
+
+    def _next_tid(self) -> PureThreadId:
+        tid = PureThreadId(self._tid_counter)
+        self._tid_counter += 1
+        return tid
+
+    def _spawn(self, program_fn: ProgramFn, log_name: str, *,
+               is_main: bool) -> _Thread:
+        th = _Thread(tid=self._next_tid(), gen=None, program=program_fn,
+                     is_main=is_main, log_name=log_name)
+        self._threads[th.tid] = th
+        return th
+
+    def _push(self, th: _Thread, time: Microsecond, value: Any) -> None:
+        entry = [time, self._seq, th.tid, value, False]
+        self._seq += 1
+        th.resume_entry = entry
+        heapq.heappush(self._queue, entry)
+
+    # -- effect handling -------------------------------------------------
+
+    def _step(self, th: _Thread, value: Any, exc: Optional[BaseException],
+              main_result: list, main_error: list) -> None:
+        """Drive one thread from its resume point to its next suspension."""
+        if not th.started:
+            th.started = True
+            prog_fn, th.program = th.program, None
+            assert prog_fn is not None
+            if exc is not None:
+                # Exception delivered before the body ran: no user handler
+                # can be installed yet, so the thread dies immediately
+                # (matches the top-level-catch placement, TimedT.hs:332-338).
+                self._finish(th, exc, main_result, main_error)
+                return
+            try:
+                g = prog_fn()  # create the frame lazily
+            except BaseException as e:  # noqa: BLE001
+                self._finish(th, e, main_result, main_error)
+                return
+            if not hasattr(g, "send"):
+                # A yield-free program is a plain function: it already ran
+                # to completion at frame-creation time.
+                self._finish(th, None, main_result, main_error, result=g)
+                return
+            th.gen = g
+        gen = th.gen
+        assert gen is not None
+        try:
+            while True:
+                if exc is not None:
+                    e, exc, value = exc, None, None
+                    eff = gen.throw(e)
+                else:
+                    eff, value = gen.send(value), None
+
+                if type(eff) is Wait:
+                    # ≙ wait: capture continuation, enqueue at
+                    # max(now, spec(now)) (TimedT.hs:343-355).
+                    self._push(th, resolve(eff.spec, self._time), None)
+                    return
+                elif type(eff) is GetTime:
+                    value = self._time  # ≙ virtualTime (TimedT.hs:322)
+                elif type(eff) is MyTid:
+                    value = th.tid
+                elif type(eff) is Fork:
+                    # ≙ fork (TimedT.hs:326-342): child enqueued at `now`
+                    # (inheriting the logger name), parent yields 1 µs and
+                    # then receives the child tid.
+                    child = self._spawn(eff.program, th.log_name,
+                                        is_main=False)
+                    self._push(child, self._time, None)
+                    self._push(th, self._time + 1, child.tid)
+                    return
+                elif type(eff) is ThrowTo:
+                    self._throw_to(eff.tid, eff.exc)
+                elif type(eff) is GetLogName:
+                    value = th.log_name
+                elif type(eff) is SetLogName:
+                    th.log_name = eff.name
+                else:
+                    raise TypeError(f"unknown effect: {eff!r}")
+        except StopIteration as stop:
+            self._finish(th, None, main_result, main_error,
+                         result=stop.value)
+        except BaseException as e:  # noqa: BLE001 — interpreter boundary
+            self._finish(th, e, main_result, main_error)
+
+    def _throw_to(self, tid: PureThreadId, exc: BaseException) -> None:
+        """≙ throwTo (TimedT.hs:357-368): wake the target to `now`, then
+        store the exception — first thrower wins (TimedT.hs:359)."""
+        th = self._threads.get(tid)
+        if th is None or not th.alive:
+            return
+        if th.resume_entry is not None and th.resume_entry[_TIME] > self._time:
+            th.resume_entry[_CANCELLED] = True
+            self._push(th, self._time, th.resume_entry[_VALUE])
+        self._pending_exc.setdefault(tid, exc)
+
+    def _finish(self, th: _Thread, exc: Optional[BaseException],
+                main_result: list, main_error: list, *,
+                result: Any = None) -> None:
+        th.alive = False
+        th.gen = None
+        self._pending_exc.pop(th.tid, None)
+        if th.is_main:
+            if exc is not None:
+                main_error.append(exc)
+            else:
+                main_result.append(result)
+        elif exc is not None:
+            # ≙ threadKilledNotifier (TimedT.hs:306-316).
+            level = logging.DEBUG if isinstance(exc, ThreadKilled) \
+                else logging.WARNING
+            _log.log(level, "[%s] Thread killed by exception: %r",
+                     th.log_name, exc)
+
+
+def run_emulation(program_fn: ProgramFn, **kw: Any) -> Any:
+    """One-shot convenience ≙ ``runTimedT`` (TimedT.hs:293-304)."""
+    return PureEmulation(**kw).run(program_fn)
