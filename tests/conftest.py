@@ -1,17 +1,28 @@
 """Test configuration.
 
-Must run before any ``jax`` import: forces an 8-device virtual CPU
-platform so multi-chip sharding (``jax.sharding.Mesh`` + ``shard_map``)
-is exercised without TPU hardware, per the driver contract.
+Tests always run on a virtual 8-device CPU platform (multi-chip
+sharding without TPU hardware, per the driver contract).
+
+Subtlety: the ambient environment routes JAX at a remote TPU tunnel —
+a sitecustomize hook imports jax at interpreter start with
+``JAX_PLATFORMS=axon``, so mutating ``os.environ`` here is too late for
+the platform choice (jax's config already captured it) and a wedged
+tunnel would hang every test. ``jax.config.update`` after import is
+still honored because no backend has been initialized yet.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
 
 from hypothesis import settings  # noqa: E402
 

@@ -1,0 +1,34 @@
+"""Host/device trace-hash agreement (trace/hashing.py)."""
+
+import numpy as np
+
+from timewarp_tpu.trace.hashing import combine_py, mix32_jnp, mix32_py
+
+
+def test_mix32_host_device_agree():
+    import jax.numpy as jnp
+    cases = [
+        (1, 2, 3),
+        (0,),
+        (2**31 - 1, -5, 7),
+        (2**62 + 12345 & 0xFFFFFFFF, 99),
+        (123456789, 987654321, 42, 7, 1),
+    ]
+    for xs in cases:
+        host = mix32_py(*xs)
+        dev = int(mix32_jnp(*[jnp.asarray(x, jnp.int64) for x in xs]))
+        assert host == dev, xs
+
+
+def test_mix32_vectorized_matches_scalar():
+    import jax.numpy as jnp
+    a = np.array([1, 5, 2**31 - 1, 0], np.int64)
+    b = np.array([9, 8, 7, 6], np.int64)
+    vec = mix32_jnp(jnp.asarray(a), jnp.asarray(b))
+    for i in range(len(a)):
+        assert int(vec[i]) == mix32_py(int(a[i]), int(b[i]))
+
+
+def test_combine_order_independent():
+    hs = [mix32_py(i, i * 7) for i in range(100)]
+    assert combine_py(hs) == combine_py(list(reversed(hs)))

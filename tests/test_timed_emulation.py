@@ -323,6 +323,44 @@ def test_start_timer():
 
 # --- the canonical two-mode doc example (Timed.hs:14-40) ----------------
 
+def test_interpreter_instance_reusable():
+    """A second run() on one PureEmulation starts from a fresh scenario."""
+    emu = PureEmulation()
+
+    def prog():
+        yield Wait(for_(5))
+        return (yield GetTime())
+
+    assert emu.run(prog) == 5
+    assert emu.run(prog) == 5  # not 10
+
+
+def test_self_throw_delivered_at_next_suspension():
+    """Self-throw contract (ThrowTo docstring): delivered at the next
+    suspension's own time; lost if the thread never suspends again."""
+    from timewarp_tpu.core.effects import MyTid, ThrowTo
+    seen = []
+
+    def prog():
+        tid = yield MyTid()
+        yield ThrowTo(tid, ThreadKilled())
+        try:
+            yield Wait(for_(sec(100)))
+        except ThreadKilled:
+            seen.append((yield GetTime()))
+        return "done"
+
+    assert run_emulation(prog) == "done"
+    assert seen == [sec(100)]  # at the wait's own time, not pre-empted
+
+    def prog2():
+        tid = yield MyTid()
+        yield ThrowTo(tid, ThreadKilled())
+        return "survived"  # never suspends again -> exception evaporates
+
+    assert run_emulation(prog2) == "survived"
+
+
 def test_wait_costs_zero_wallclock():
     import time as _wall
 

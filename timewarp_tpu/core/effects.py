@@ -109,6 +109,12 @@ class ThrowTo(Effect):
     thread, the first one wins (TimedT.hs:359 keeps the existing entry).
     A thread may only be interrupted at a suspension point; straight-line
     code between waits is uninterruptible (TimedT.hs:324-325).
+
+    Self-throw contract (also inherited from the reference): throwing at
+    the *currently running* thread stores the exception but cannot wake
+    a resume event that does not exist yet — it is delivered when the
+    thread's next suspension fires (at that suspension's own time), and
+    silently evaporates if the thread finishes without suspending again.
     """
     tid: Any
     exc: BaseException

@@ -1,0 +1,311 @@
+"""The batched XLA engine: whole-network emulation as one compiled program.
+
+This is the third interpreter the reference never had (BASELINE.json
+north star): the pure emulator's event loop
+(`/root/reference/src/Control/TimeWarp/Timed/TimedT.hs:234-286`)
+re-designed for the TPU's execution model:
+
+- the priority event queue (TimedT.hs:109) becomes a per-node
+  ``next_wake`` array plus bounded per-node mailboxes — the global
+  "pop min" is an ``argmin``-free masked ``min`` reduction;
+- threads-as-continuations (TimedT.hs:146-151) become explicit node
+  states advanced by a ``vmap``-ed step function;
+- virtual time is driven by ``lax.scan`` (traced once, compiled once;
+  no data-dependent Python control flow);
+- message delivery is a static-shape scatter with deterministic
+  sender-major ranking (and, in the sharded engine, an ``all_to_all``
+  over the TPU mesh — see sharded.py).
+
+All supersteps execute the *fire-all-at-min* semantics of
+core/scenario.py, and the emitted trace must equal the host oracle's
+bit-for-bit (tests/test_parity.py). Everything observable is integer;
+time is int64 µs.
+
+Design notes for the MXU/VPU: the engine's own bookkeeping is
+elementwise/VPU work by nature (sorts, min-reductions, scatters over
+[N, K] int arrays); the MXU earns its keep inside user step functions
+(e.g. model-driven scenarios) which are free to use bf16 matmuls — the
+engine keeps them fused into the same scanned XLA computation.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, NamedTuple, Optional, Tuple
+
+from ...utils import jaxconfig  # noqa: F401  (must precede jax use)
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.scenario import NEVER, Inbox, Scenario
+from ...net.delays import LinkModel
+from ...trace.events import SuperstepTrace
+from ...trace.hashing import FIRED, RECV, SENT, mix32_jnp
+from .rng import fire_key, msg_key
+
+__all__ = ["JaxEngine", "EngineState"]
+
+
+class EngineState(NamedTuple):
+    """The complete simulation state — one pytree, trivially
+    checkpointable (SURVEY.md §5.4) and shardable over a mesh."""
+    states: Any        # scenario pytree, leading dim N
+    wake: jax.Array    # int64[N]
+    mb_time: jax.Array     # int64[N, K]
+    mb_src: jax.Array      # int32[N, K]
+    mb_payload: jax.Array  # int32[N, K, P]
+    mb_valid: jax.Array    # bool[N, K]
+    overflow: jax.Array    # int32[] — total overflowed messages
+    bad_dst: jax.Array     # int32[] — total messages to invalid destinations
+    delivered: jax.Array   # int64[] — total delivered messages
+    steps: jax.Array       # int64[] — supersteps executed
+    time: jax.Array        # int64[] — current virtual time
+
+
+class _StepOut(NamedTuple):
+    """Per-superstep trace row (valid=False once the scenario quiesced)."""
+    valid: jax.Array
+    t: jax.Array
+    fired_count: jax.Array
+    fired_hash: jax.Array
+    recv_count: jax.Array
+    recv_hash: jax.Array
+    sent_count: jax.Array
+    sent_hash: jax.Array
+    overflow: jax.Array
+
+
+def _u32sum(x: jax.Array) -> jax.Array:
+    return jnp.sum(x.astype(jnp.uint32), dtype=jnp.uint32)
+
+
+def _tlo(t: jax.Array) -> jax.Array:
+    return (t & jnp.int64(0xFFFFFFFF)).astype(jnp.uint32)
+
+
+def _thi(t: jax.Array) -> jax.Array:
+    return ((t >> jnp.int64(32)) & jnp.int64(0xFFFFFFFF)).astype(jnp.uint32)
+
+
+class JaxEngine:
+    """Single-chip batched engine. ``run(max_steps)`` executes up to
+    ``max_steps`` supersteps under one ``lax.scan`` and returns the
+    final :class:`EngineState` plus the trace; ``run_quiet`` drops the
+    per-step trace (pure ``lax.while_loop``) for benchmarking."""
+
+    def __init__(self, scenario: Scenario, link: LinkModel, *,
+                 seed: int = 0) -> None:
+        self.scenario = scenario
+        self.link = link
+        self.key = jax.random.PRNGKey(seed)
+
+    # -- initial state ---------------------------------------------------
+
+    def init_state(self) -> EngineState:
+        sc = self.scenario
+        n, K, P = sc.n_nodes, sc.mailbox_cap, sc.payload_width
+        if sc.init_batched is not None:
+            states, wake = sc.init_batched(n)
+            wake = jnp.asarray(wake, jnp.int64)
+        else:
+            per = [sc.init(i) for i in range(n)]
+            states = jax.tree.map(
+                lambda *xs: jnp.stack([jnp.asarray(x) for x in xs]),
+                *[p[0] for p in per])
+            wake = jnp.asarray([p[1] for p in per], jnp.int64)
+        return EngineState(
+            states=states,
+            wake=wake,
+            mb_time=jnp.full((n, K), NEVER, jnp.int64),
+            mb_src=jnp.zeros((n, K), jnp.int32),
+            mb_payload=jnp.zeros((n, K, P), jnp.int32),
+            mb_valid=jnp.zeros((n, K), bool),
+            overflow=jnp.int32(0),
+            bad_dst=jnp.int32(0),
+            delivered=jnp.int64(0),
+            steps=jnp.int64(0),
+            time=jnp.int64(0),
+        )
+
+    # -- one superstep ---------------------------------------------------
+
+    def _superstep(self, st: EngineState) -> Tuple[EngineState, _StepOut]:
+        sc = self.scenario
+        n, K, M, P = sc.n_nodes, sc.mailbox_cap, sc.max_out, sc.payload_width
+        node_ids = jnp.arange(n, dtype=jnp.int32)
+
+        # 1. global next event time (the batched "pop min", TimedT.hs:241-245)
+        mb_eff = jnp.where(st.mb_valid, st.mb_time, NEVER)
+        node_next = jnp.minimum(st.wake, mb_eff.min(axis=1))
+        t = node_next.min()
+        live = t < NEVER
+        fire = (node_next == t) & live
+
+        # 2. deliverable messages, per firing node
+        deliver = st.mb_valid & (st.mb_time <= t) & fire[:, None]
+
+        # 3. inbox: delivered slots first, ordered by (time, arrival slot)
+        #    (determinism contract #2)
+        slots = jnp.broadcast_to(jnp.arange(K, dtype=jnp.int32), (n, K))
+        perm = jnp.lexsort((slots, st.mb_time, ~deliver), axis=-1)
+        take = partial(jnp.take_along_axis, axis=1)
+        ib_valid = take(deliver, perm)
+        # pad invalid slots exactly like the oracle (src=0, time=NEVER,
+        # payload=0) so an unmasked read in a user step function cannot
+        # diverge between interpreters
+        inbox = Inbox(
+            valid=ib_valid,
+            src=jnp.where(ib_valid, take(st.mb_src, perm), 0),
+            time=jnp.where(ib_valid, take(st.mb_time, perm), NEVER),
+            payload=jnp.where(
+                ib_valid[:, :, None],
+                jnp.take_along_axis(st.mb_payload, perm[:, :, None], axis=1),
+                0),
+        )
+
+        # 4. fire every node simultaneously; mask non-fired results
+        keys = jax.vmap(lambda i: fire_key(self.key, i, t))(node_ids)
+        new_states, out, new_wake = jax.vmap(
+            sc.step, in_axes=(0, 0, None, 0, 0))(
+                st.states, inbox, t, node_ids, keys)
+        states = jax.tree.map(
+            lambda a, b: jnp.where(
+                fire.reshape((n,) + (1,) * (b.ndim - 1)), b, a),
+            st.states, new_states)
+        new_wake = jnp.where(new_wake >= NEVER, NEVER,
+                             jnp.maximum(new_wake, t + 1))  # contract #5
+        wake = jnp.where(fire, new_wake, st.wake)
+        out_valid = out.valid & fire[:, None]
+
+        # 5. compact mailboxes: drop delivered, keep arrival order
+        keep = st.mb_valid & ~deliver
+        perm2 = jnp.lexsort((slots, ~keep), axis=-1)
+        mb_time = take(st.mb_time, perm2)
+        mb_src = take(st.mb_src, perm2)
+        mb_payload = jnp.take_along_axis(st.mb_payload, perm2[:, :, None],
+                                         axis=1)
+        mb_valid = take(keep, perm2)
+        counts = mb_valid.sum(axis=1, dtype=jnp.int32)
+
+        # 6. route outboxes in sender-major order (contract #3)
+        S = n * M
+        src_f = jnp.repeat(node_ids, M)
+        slot_f = jnp.tile(jnp.arange(M, dtype=jnp.int32), n)
+        dst_f = out.dst.reshape(S).astype(jnp.int32)
+        pay_f = out.payload.reshape(S, P)
+        v_f = out_valid.reshape(S)
+        mkeys = jax.vmap(lambda s, d, sl: msg_key(self.key, s, d, t, sl))(
+            src_f, dst_f, slot_f)
+        delay, drop = jax.vmap(
+            lambda s, d, k: self.link.sample(s, d, t, k))(src_f, dst_f, mkeys)
+        dst_ok = (dst_f >= 0) & (dst_f < n)
+        ok = v_f & ~drop & dst_ok
+        # contract #6 corollary: a scenario emitting an out-of-range
+        # destination is a bug — surfaced, never silently dropped
+        bad_dst_step = jnp.sum(v_f & ~dst_ok, dtype=jnp.int32)
+        dtime = t + jnp.maximum(delay.astype(jnp.int64), 1)  # contract #4
+
+        # 7. insert: stable sort by destination; rank within destination
+        #    = sender-major arrival order; bounded by mailbox capacity
+        sort_dst = jnp.where(ok, dst_f, n)  # invalid -> sentinel row n
+        perm3 = jnp.argsort(sort_dst, stable=True)
+        sd = sort_dst[perm3]
+        rank = jnp.arange(S, dtype=jnp.int32) - jnp.searchsorted(
+            sd, sd, side="left").astype(jnp.int32)
+        base = counts[jnp.clip(sd, 0, n - 1)]
+        pos = base + rank
+        ok_s = ok[perm3]
+        fits = ok_s & (pos < K)
+        row = jnp.where(fits, sd, n)  # out-of-range row -> dropped scatter
+        col = jnp.clip(pos, 0, K - 1)
+        mb_time = mb_time.at[row, col].set(dtime[perm3], mode="drop")
+        mb_src = mb_src.at[row, col].set(src_f[perm3], mode="drop")
+        mb_payload = mb_payload.at[row, col].set(pay_f[perm3], mode="drop")
+        mb_valid = mb_valid.at[row, col].set(fits, mode="drop")
+        overflow_step = jnp.sum(ok_s & (pos >= K), dtype=jnp.int32)
+
+        # 8. trace digests (order-independent — trace/hashing.py)
+        fired_hash = _u32sum(jnp.where(fire, mix32_jnp(FIRED, node_ids), 0))
+        recv_mix = mix32_jnp(
+            RECV, jnp.broadcast_to(node_ids[:, None], (n, K)),
+            inbox.src, _tlo(inbox.time), _thi(inbox.time),
+            inbox.payload[:, :, 0])
+        recv_hash = _u32sum(jnp.where(inbox.valid, recv_mix, 0))
+        sent_mix = mix32_jnp(SENT, src_f, dst_f, _tlo(dtime), _thi(dtime),
+                             pay_f[:, 0])
+        sent_hash = _u32sum(jnp.where(ok, sent_mix, 0))
+        recv_count = jnp.sum(inbox.valid, dtype=jnp.int32)
+        sent_count = jnp.sum(ok, dtype=jnp.int32)
+
+        new_st = EngineState(
+            states=states, wake=wake,
+            mb_time=mb_time, mb_src=mb_src, mb_payload=mb_payload,
+            mb_valid=mb_valid,
+            overflow=st.overflow + overflow_step,
+            bad_dst=st.bad_dst + bad_dst_step,
+            delivered=st.delivered + recv_count.astype(jnp.int64),
+            steps=st.steps + 1,
+            time=t,
+        )
+        # freeze everything once quiesced
+        final = jax.tree.map(lambda a, b: jnp.where(live, b, a), st, new_st)
+        yrow = _StepOut(
+            valid=live, t=t,
+            fired_count=jnp.sum(fire, dtype=jnp.int32),
+            fired_hash=fired_hash,
+            recv_count=recv_count, recv_hash=recv_hash,
+            sent_count=sent_count, sent_hash=sent_hash,
+            overflow=overflow_step,
+        )
+        # mask the trace row too when not live
+        yrow = jax.tree.map(
+            lambda x: jnp.where(live, x, jnp.zeros_like(x)), yrow)
+        return final, yrow
+
+    # -- drivers ---------------------------------------------------------
+
+    @partial(jax.jit, static_argnums=(0, 2))
+    def _run_scan(self, st: EngineState, max_steps: int):
+        def body(carry, _):
+            return self._superstep(carry)
+        return jax.lax.scan(body, st, None, length=max_steps)
+
+    def run(self, max_steps: int,
+            state: Optional[EngineState] = None
+            ) -> Tuple[EngineState, SuperstepTrace]:
+        """Execute up to ``max_steps`` supersteps; returns final state and
+        the trace of the supersteps that actually fired."""
+        st = state if state is not None else self.init_state()
+        final, ys = self._run_scan(st, max_steps)
+        ys = jax.device_get(ys)
+        m = np.asarray(ys.valid)
+        rows = list(zip(
+            np.asarray(ys.t)[m], np.asarray(ys.fired_count)[m],
+            np.asarray(ys.fired_hash)[m], np.asarray(ys.recv_count)[m],
+            np.asarray(ys.recv_hash)[m], np.asarray(ys.sent_count)[m],
+            np.asarray(ys.sent_hash)[m], np.asarray(ys.overflow)[m]))
+        return final, SuperstepTrace.from_rows(rows)
+
+    @partial(jax.jit, static_argnums=(0, 2))
+    def _run_while(self, st: EngineState, max_steps: int) -> EngineState:
+        start_steps = st.steps  # max_steps is per-call, same as run()
+
+        def cond(carry):
+            mb_eff = jnp.where(carry.mb_valid, carry.mb_time, NEVER)
+            nxt = jnp.minimum(carry.wake.min(), mb_eff.min())
+            return (nxt < NEVER) & (carry.steps - start_steps < max_steps)
+
+        def body(carry):
+            nxt, _ = self._superstep(carry)
+            return nxt
+
+        return jax.lax.while_loop(cond, body, st)
+
+    def run_quiet(self, max_steps: int,
+                  state: Optional[EngineState] = None) -> EngineState:
+        """Traceless driver for benchmarking: one ``while_loop``, no
+        per-step host materialization."""
+        st = state if state is not None else self.init_state()
+        return self._run_while(st, max_steps)

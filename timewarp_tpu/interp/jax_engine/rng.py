@@ -1,0 +1,48 @@
+"""Counter-based RNG key derivation shared by oracle and engine.
+
+The reference threads one ``StdGen`` through the emulated network
+(seeded ``mkStdGen 0``, examples/token-ring/Main.hs:60, 82-85) — a
+*sequential* RNG that cannot be evaluated in parallel. The TPU build
+replaces it with counter-based derivation (SURVEY.md §5.3): every
+random draw is keyed by *what* it is for — ``(node, time)`` for a
+firing, ``(src, dst, time, slot)`` for a link sample — so any engine,
+batched or sequential, sharded or not, derives bit-identical streams.
+
+Threefry (JAX's default) is integer-based and backend-deterministic, so
+fold-in chains agree between the CPU oracle and the TPU engine.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["fold_time", "fire_key", "msg_key"]
+
+_MASK32 = (1 << 32) - 1
+
+
+def fold_time(key: jax.Array, t) -> jax.Array:
+    """Fold a µs timestamp (int64 range) into a key as two 32-bit words."""
+    t = jnp.asarray(t, jnp.int64)
+    lo = jnp.asarray(t & _MASK32, jnp.uint32)
+    hi = jnp.asarray((t >> 32) & _MASK32, jnp.uint32)
+    return jax.random.fold_in(jax.random.fold_in(key, lo), hi)
+
+
+def fire_key(key: jax.Array, node, t) -> jax.Array:
+    """Key for one node's firing at virtual time ``t``."""
+    return fold_time(jax.random.fold_in(key, jnp.asarray(node, jnp.uint32)), t)
+
+
+def msg_key(key: jax.Array, src, dst, t, slot) -> jax.Array:
+    """Key for the link sample of one message: sender ``src`` -> ``dst``
+    emitted at time ``t`` from outbox slot ``slot``.
+
+    ≙ the role of the seeded ``Delays`` function in the removed API
+    (examples/token-ring/Main.hs:73-77), made order-independent.
+    """
+    k = jax.random.fold_in(key, jnp.asarray(src, jnp.uint32))
+    k = jax.random.fold_in(k, jnp.asarray(dst, jnp.uint32))
+    k = fold_time(k, t)
+    return jax.random.fold_in(k, jnp.asarray(slot, jnp.uint32))

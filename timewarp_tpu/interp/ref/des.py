@@ -93,6 +93,13 @@ class PureEmulation:
         return self._time
 
     def run(self, program_fn: ProgramFn) -> Any:
+        # fresh scenario per run (≙ evalStateT emptyScenario, TimedT.hs:227)
+        self._queue = []
+        self._threads = {}
+        self._pending_exc = {}
+        self._time = 0
+        self._seq = 0
+        self._tid_counter = 0
         main = self._spawn(program_fn, self._default_log_name, is_main=True)
         self._push(main, self._time, None)
         main_result: List[Any] = []
@@ -222,6 +229,9 @@ class PureEmulation:
         th.alive = False
         th.gen = None
         self._pending_exc.pop(th.tid, None)
+        # evict: memory stays O(live threads), not O(total forks);
+        # _throw_to treats a missing tid exactly like a dead one
+        self._threads.pop(th.tid, None)
         if th.is_main:
             if exc is not None:
                 main_error.append(exc)

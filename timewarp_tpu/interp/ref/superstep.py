@@ -1,0 +1,189 @@
+"""Host reference executor for state-machine scenarios — the oracle.
+
+Runs a :class:`~timewarp_tpu.core.scenario.Scenario` sequentially on the
+host, implementing the shared superstep semantics (core/scenario.py
+docstring) with plain Python data structures: per-node mailbox *lists*,
+a Python min-scan for the clock, Python loops for routing and overflow.
+This is the direct descendant of the reference's event loop
+(`/root/reference/src/Control/TimeWarp/Timed/TimedT.hs:234-286`): a
+global clock advanced to the minimum pending event time, with per-node
+mailboxes instead of a single continuation queue. The batched XLA
+engine (interp/jax_engine) must reproduce this executor's trace
+bit-for-bit — that law is the framework's acceptance gate (SURVEY.md §6).
+
+The scenario's ``step`` and the link model's ``sample`` are the *same
+jax functions* the engine uses — evaluated here through one ``vmap``
+per superstep (vmap of a pure function is just map; batching cannot
+change values) so the oracle stays fast enough to check thousand-node
+runs. All *scheduling* decisions — who fires, what each inbox
+contains, message ordering, capacity — are made by independent host
+code, which is what makes this an oracle rather than a second copy of
+the engine.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ...utils import jaxconfig  # noqa: F401  (must precede jax use)
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.scenario import NEVER, Inbox, Scenario
+from ...core.time import Microsecond
+from ...net.delays import LinkModel
+from ...trace.events import SuperstepTrace
+from ...trace.hashing import FIRED, RECV, SENT, combine_py, mix32_py
+from ..jax_engine.rng import fire_key, msg_key
+
+__all__ = ["SuperstepOracle"]
+
+_MASK32 = (1 << 32) - 1
+
+
+class SuperstepOracle:
+    """Sequential host executor; oracle for trace parity."""
+
+    def __init__(self, scenario: Scenario, link: LinkModel, *,
+                 seed: int = 0) -> None:
+        self.scenario = scenario
+        self.link = link
+        self.key = jax.random.PRNGKey(seed)
+        n = scenario.n_nodes
+        per = [scenario.init(i) for i in range(n)]
+        #: stacked numpy state pytree (row i = node i)
+        self.states = jax.tree.map(
+            lambda *xs: np.stack([np.asarray(x) for x in xs]),
+            *[p[0] for p in per])
+        self.wake: List[int] = [int(p[1]) for p in per]
+        #: per-node arrival-ordered pending (deliver_time, src, payload)
+        self.mailbox: List[List[tuple]] = [[] for _ in range(n)]
+        self.overflow_total = 0
+        self.bad_dst_total = 0
+        self.time: Microsecond = 0
+
+        ids = jnp.arange(n, dtype=jnp.int32)
+        M = scenario.max_out
+        src_f = jnp.repeat(ids, M)
+        slot_f = jnp.tile(jnp.arange(M, dtype=jnp.int32), n)
+
+        # one vmapped step per superstep — same fn the engine vmaps
+        def _vstep(states, inbox, t):
+            keys = jax.vmap(lambda i: fire_key(self.key, i, t))(ids)
+            return jax.vmap(scenario.step, in_axes=(0, 0, None, 0, 0))(
+                states, inbox, t, ids, keys)
+
+        self._vstep = jax.jit(_vstep)
+
+        # one batched link sample per superstep, keyed per (src,dst,t,slot)
+        def _vsample(dst, t):
+            keys = jax.vmap(lambda s, d, sl: msg_key(self.key, s, d, t, sl))(
+                src_f, dst, slot_f)
+            return jax.vmap(lambda s, d, k: link.sample(s, d, t, k))(
+                src_f, dst, keys)
+
+        self._vsample = jax.jit(_vsample)
+
+    # ------------------------------------------------------------------
+
+    def _node_next(self, i: int) -> int:
+        m = min((mm[0] for mm in self.mailbox[i]), default=NEVER)
+        return min(self.wake[i], m)
+
+    # ------------------------------------------------------------------
+
+    def run(self, max_steps: int = 1 << 30,
+            until: Optional[Microsecond] = None) -> SuperstepTrace:
+        sc = self.scenario
+        n, M, K, P = sc.n_nodes, sc.max_out, sc.mailbox_cap, sc.payload_width
+        rows = []
+        for _ in range(max_steps):
+            nexts = [self._node_next(i) for i in range(n)]
+            t = min(nexts)
+            if t >= NEVER or (until is not None and t > until):
+                break
+            self.time = t
+            fired = [i for i in range(n) if nexts[i] == t]
+            fired_hash = combine_py(mix32_py(FIRED, i) for i in fired)
+
+            # build inboxes (host decision: contract #2 ordering)
+            ib_valid = np.zeros((n, K), bool)
+            ib_src = np.zeros((n, K), np.int32)
+            ib_time = np.full((n, K), NEVER, np.int64)
+            ib_pay = np.zeros((n, K, P), np.int32)
+            recv_hashes: List[int] = []
+            recv_count = 0
+            for i in fired:
+                pend = self.mailbox[i]
+                picked = sorted(
+                    ((m, idx) for idx, m in enumerate(pend) if m[0] <= t),
+                    key=lambda mi: (mi[0][0], mi[1]))
+                self.mailbox[i] = [m for m in pend if m[0] > t]
+                for j, (m, _) in enumerate(picked):
+                    ib_valid[i, j] = True
+                    ib_time[i, j] = m[0]
+                    ib_src[i, j] = m[1]
+                    ib_pay[i, j] = m[2]
+                    recv_hashes.append(mix32_py(
+                        RECV, i, m[1], m[0] & _MASK32, m[0] >> 32,
+                        int(m[2][0]) if P else 0))
+                recv_count += len(picked)
+
+            inbox = Inbox(valid=ib_valid, src=ib_src, time=ib_time,
+                          payload=ib_pay)
+            new_states, out, new_wake = self._vstep(
+                self.states, inbox, jnp.int64(t))
+            new_states = jax.tree.map(np.asarray, new_states)
+            out_valid = np.asarray(out.valid)
+            out_dst = np.asarray(out.dst, dtype=np.int32)
+            out_pay = np.asarray(out.payload)
+            new_wake = np.asarray(new_wake)
+
+            # apply results for fired nodes only (host decision)
+            fired_arr = np.asarray(fired, dtype=np.int64)
+            def _apply(cur, new):
+                cur[fired_arr] = new[fired_arr]
+                return cur
+            self.states = jax.tree.map(_apply, self.states, new_states)
+            for i in fired:
+                w = int(new_wake[i])
+                # contract #5: clamp re-arm strictly past now
+                self.wake[i] = NEVER if w >= NEVER else max(w, t + 1)
+
+            # route in sender-major order (contract #3)
+            delay, drop = self._vsample(jnp.asarray(out_dst.reshape(-1)),
+                                        jnp.int64(t))
+            delay = np.asarray(delay).reshape(n, M)
+            drop = np.asarray(drop).reshape(n, M)
+            sent_hashes: List[int] = []
+            sent_count = 0
+            overflow_step = 0
+            for i in fired:
+                for slot in range(M):
+                    if not out_valid[i, slot]:
+                        continue
+                    dst = int(out_dst[i, slot])
+                    if not (0 <= dst < n):
+                        self.bad_dst_total += 1  # surfaced, never silent
+                        continue
+                    if drop[i, slot]:
+                        continue
+                    dt = t + max(int(delay[i, slot]), 1)  # contract #4
+                    p0 = int(out_pay[i, slot, 0]) if P else 0
+                    sent_count += 1
+                    sent_hashes.append(mix32_py(
+                        SENT, i, dst, dt & _MASK32, dt >> 32, p0))
+                    if len(self.mailbox[dst]) >= K:
+                        overflow_step += 1  # contract #6: counted, dropped
+                    else:
+                        self.mailbox[dst].append(
+                            (dt, i, np.asarray(out_pay[i, slot], np.int32)))
+            self.overflow_total += overflow_step
+
+            rows.append((t, len(fired), fired_hash,
+                         recv_count, combine_py(recv_hashes),
+                         sent_count, combine_py(sent_hashes),
+                         overflow_step))
+        return SuperstepTrace.from_rows(rows)

@@ -1,0 +1,73 @@
+"""Ping-pong scenario — the reference's smallest two-node example.
+
+Behavioral spec: `/root/reference/examples/ping-pong/Main.hs:53-77`:
+node 0 sends ``Ping``, node 1 answers ``Pong`` (a typed listener
+replying on the inbound connection), for a configurable number of
+rounds. Payload layout: ``[seq, kind]``.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+from ..utils import jaxconfig  # noqa: F401
+
+import jax.numpy as jnp
+
+from ..core.scenario import NEVER, Inbox, Outbox, Scenario
+from ..core.time import Microsecond
+
+__all__ = ["ping_pong", "PING", "PONG"]
+
+PING, PONG = 0, 1
+
+
+def ping_pong(*, rounds: int = 10, start_us: Microsecond = 0,
+              mailbox_cap: int = 4) -> Scenario:
+    """Two nodes; node 0 drives ``rounds`` ping/pong exchanges."""
+
+    def step(state, inbox: Inbox, now, i, key):
+        rem, seq = state["rem"], state["seq"]
+        kind = inbox.payload[:, 1]
+        vin = inbox.payload[:, 0]
+        pong_in = inbox.valid & (kind == PONG)
+        ping_in = inbox.valid & (kind == PING)
+        is_pinger = i == 0
+
+        # node 0: send the first ping at start, then one per pong
+        kick = is_pinger & (now == jnp.int64(start_us)) & (seq == 0)
+        got_pong = pong_in.any()
+        send_ping = is_pinger & (kick | (got_pong & (rem > 1)))
+        rem1 = jnp.where(is_pinger & got_pong, rem - 1, rem)
+        seq1 = jnp.where(send_ping, seq + 1, seq)
+
+        # node 1: echo every ping back (reference Listener replies once
+        # per message; max_out bounds co-temporal echoes)
+        ping_v = jnp.max(jnp.where(ping_in, vin, jnp.int32(0)))
+        send_pong = (~is_pinger) & ping_in.any()
+
+        valid = jnp.stack([send_ping | send_pong])
+        dst = jnp.stack([jnp.where(is_pinger, 1, 0).astype(jnp.int32)])
+        payload = jnp.stack([jnp.stack([
+            jnp.where(is_pinger, seq1, ping_v),
+            jnp.where(is_pinger, PING, PONG).astype(jnp.int32)])])
+        out = Outbox(valid=valid, dst=dst, payload=payload)
+
+        state1 = {"rem": rem1, "seq": seq1}
+        wake = jnp.int64(NEVER)
+        return state1, out, wake
+
+    def init(i: int) -> Tuple[dict, Microsecond]:
+        state = {"rem": jnp.int32(rounds), "seq": jnp.int32(0)}
+        return state, start_us if i == 0 else NEVER
+
+    return Scenario(
+        name="ping-pong",
+        n_nodes=2,
+        step=step,
+        init=init,
+        payload_width=2,
+        max_out=1,
+        mailbox_cap=mailbox_cap,
+        meta={"rounds": rounds},
+    )

@@ -1,0 +1,109 @@
+"""Link models: per-message latency and loss injection.
+
+Revives the reference's removed fault-injection surface — ``Delays`` /
+``ConnectionOutcome`` (examples/token-ring/Main.hs:73-77; the README's
+promised "manually controlled network nastiness", README.md:13-15) — as
+first-class, *batchable* models: a link model is a pure function from
+``(src, dst, send_time, key)`` to ``(delay_µs, drop)``, written in
+jax.numpy so the same code vmaps over millions of messages on TPU and
+evaluates per-message in the host oracle with identical bits.
+
+All delays are int64 µs; the engine clamps in-flight time to ≥ 1 µs
+(determinism contract #4, core/scenario.py).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "LinkModel", "FixedDelay", "UniformDelay", "LogNormalDelay",
+    "WithDrop", "FnDelay", "NEVER_CONNECTED",
+]
+
+#: Drop probability 1 — ≙ the old API's ``NeverConnected`` outcome.
+NEVER_CONNECTED = 1.0
+
+
+class LinkModel:
+    """Base class. ``sample`` must be jittable (scalar jnp ops only)."""
+
+    def sample(self, src, dst, t, key) -> Tuple[jax.Array, jax.Array]:
+        """-> (delay int64 µs, drop bool)."""
+        raise NotImplementedError
+
+
+@dataclass(frozen=True)
+class FixedDelay(LinkModel):
+    """Every message takes exactly ``delay`` µs (≙ ``ConnectedIn d``)."""
+    delay: int
+
+    def sample(self, src, dst, t, key):
+        return jnp.asarray(self.delay, jnp.int64), jnp.asarray(False)
+
+
+@dataclass(frozen=True)
+class UniformDelay(LinkModel):
+    """Uniform integer delay in [lo, hi] µs — the token-ring example's
+    1–5 ms uniform link (examples/token-ring/Main.hs:48-49, 73-77).
+    Integer-only: bit-exact across CPU/TPU backends."""
+    lo: int
+    hi: int
+
+    def sample(self, src, dst, t, key):
+        d = jax.random.randint(key, (), self.lo, self.hi + 1, dtype=jnp.int32)
+        return jnp.asarray(d, jnp.int64), jnp.asarray(False)
+
+
+@dataclass(frozen=True)
+class LogNormalDelay(LinkModel):
+    """Lognormal latency (the gossip-100k baseline config): delay =
+    round(median * exp(sigma * N(0,1))), capped to [1, cap] µs.
+
+    Float32 internally; quantized to µs. Bit-parity is validated on CPU;
+    across CPU/TPU a boundary-rounding µs divergence is possible in
+    principle (transcendental lowering), which is why the parity *gate*
+    configs use integer models.
+    """
+    median_us: int
+    sigma: float
+    cap_us: int = 60_000_000
+
+    def sample(self, src, dst, t, key):
+        z = jax.random.normal(key, (), dtype=jnp.float32)
+        d = jnp.asarray(self.median_us, jnp.float32) * jnp.exp(
+            jnp.float32(self.sigma) * z)
+        d = jnp.clip(d, 1.0, jnp.float32(self.cap_us))
+        return jnp.asarray(jnp.round(d), jnp.int64), jnp.asarray(False)
+
+
+@dataclass(frozen=True)
+class WithDrop(LinkModel):
+    """Wrap a model with i.i.d. message loss — the "nastiness" knob
+    (socket-state-with-drop baseline config). ``drop_prob=1`` ≙ the old
+    ``NeverConnected`` outcome."""
+    inner: LinkModel
+    drop_prob: float
+
+    def sample(self, src, dst, t, key):
+        k_drop, k_inner = jax.random.split(key)
+        drop = jax.random.bernoulli(k_drop, jnp.float32(self.drop_prob))
+        delay, inner_drop = self.inner.sample(src, dst, t, k_inner)
+        return delay, drop | inner_drop
+
+
+@dataclass(frozen=True)
+class FnDelay(LinkModel):
+    """Arbitrary per-link behavior from a user function
+    ``fn(src, dst, t, key) -> (delay, drop)`` in jnp scalar ops — the
+    full generality of the old ``Delays`` newtype (a function of
+    destination and time, examples/token-ring/Main.hs:73-77)."""
+    fn: Callable
+
+    def sample(self, src, dst, t, key):
+        delay, drop = self.fn(src, dst, t, key)
+        return jnp.asarray(delay, jnp.int64), jnp.asarray(drop, bool)

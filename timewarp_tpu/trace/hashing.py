@@ -1,0 +1,66 @@
+"""Order-independent 32-bit trace hashing, host and device flavors.
+
+The parity law ("all interpreters agree on observable event traces",
+SURVEY.md §4.1's dual-interpreter pattern) needs a trace digest that
+
+1. both the host oracle (Python ints) and the XLA engine (uint32
+   arrays) can compute bit-identically, and
+2. is independent of enumeration order — co-temporal events fire
+   simultaneously in the batched engine but sequentially in the oracle,
+   and shards enumerate messages locally; an *order-independent sum* of
+   per-record mixes makes all of them agree without sorting.
+
+Each record is mixed FNV/murmur-style into 32 bits, then records are
+combined by wrapping uint32 addition.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable
+
+from ..utils import jaxconfig  # noqa: F401  (int64 inputs need x64)
+
+import jax.numpy as jnp
+
+__all__ = ["mix32_py", "mix32_jnp", "combine_py", "FIRED", "RECV", "SENT"]
+
+_M1 = 0x9E3779B1  # golden-ratio odd constant
+_M2 = 0x85EBCA77  # murmur3 finalizer constant
+_SEED = 0x811C9DC5  # FNV offset basis
+_MASK = (1 << 32) - 1
+
+# Record kind tags.
+FIRED, RECV, SENT = 1, 2, 3
+
+
+def mix32_py(*xs: int) -> int:
+    """Host flavor: mix ints (each taken mod 2^32) into one uint32."""
+    h = _SEED
+    for x in xs:
+        h ^= (int(x) & _MASK) * _M1 & _MASK
+        h = (h * _M2) & _MASK
+        h ^= h >> 16
+    return h
+
+
+def mix32_jnp(*xs) -> jnp.ndarray:
+    """Device flavor: same algorithm on uint32 arrays (broadcasting)."""
+    h = jnp.uint32(_SEED)
+    for x in xs:
+        x = jnp.asarray(x)
+        if x.dtype == jnp.int64 or x.dtype == jnp.uint64:
+            x = (x & _MASK).astype(jnp.uint32)
+        else:
+            x = x.astype(jnp.uint32)
+        h = h ^ (x * jnp.uint32(_M1))
+        h = h * jnp.uint32(_M2)
+        h = h ^ (h >> jnp.uint32(16))
+    return h
+
+
+def combine_py(hs: Iterable[int]) -> int:
+    """Order-independent combination: wrapping uint32 sum."""
+    total = 0
+    for h in hs:
+        total = (total + h) & _MASK
+    return total

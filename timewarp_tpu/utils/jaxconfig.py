@@ -1,0 +1,11 @@
+"""Central JAX configuration: import this before touching jax anywhere.
+
+Virtual time is int64 µs (SURVEY.md §7 hard-part #2: fixed-point time,
+never float), which requires x64 mode. All engine code uses explicit
+dtypes (int32/int64/float32/bfloat16) so enabling x64 never leaks
+float64 into TPU compute paths.
+"""
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
