@@ -1,0 +1,63 @@
+"""Headline benchmark: delivered-messages/sec/chip on the dense token ring.
+
+The flagship workload is the reference's north-star scenario
+(`/root/reference/examples/token-ring/Main.hs`) generalized to a dense
+ring — every node holds a token, so each superstep fires all N nodes and
+delivers N messages (the regime the BASELINE.json target describes:
+delivered-messages/sec/chip at large N).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+``vs_baseline`` is value / 1e8 (the BASELINE.json north-star target of
+>= 1e8 delivered msgs/sec/chip; the reference itself publishes no
+numbers — BASELINE.md).
+
+Env knobs: TW_BENCH_NODES (default 65536), TW_BENCH_STEPS (default 256).
+"""
+
+import json
+import os
+import time
+
+from timewarp_tpu.utils import jaxconfig  # noqa: F401
+
+import jax
+
+from timewarp_tpu.interp.jax_engine.engine import JaxEngine
+from timewarp_tpu.models.token_ring import token_ring
+from timewarp_tpu.net.delays import FixedDelay
+
+
+def main() -> None:
+    n = int(os.environ.get("TW_BENCH_NODES", 65536))
+    steps = int(os.environ.get("TW_BENCH_STEPS", 256))
+
+    # Dense ring, think_us=0: a node receiving a token forwards it in
+    # the same firing, so every superstep delivers exactly N messages.
+    # end_us far enough that the deadline never quiesces the run.
+    sc = token_ring(
+        n, n_tokens=n, think_us=0, bootstrap_us=1_000,
+        end_us=(1 << 50), with_observer=False, mailbox_cap=4)
+    engine = JaxEngine(sc, FixedDelay(500))
+
+    st = engine.init_state()
+    st = jax.block_until_ready(st)
+
+    # Warmup: compile the while_loop driver (first TPU compile 20-40 s).
+    warm = jax.block_until_ready(engine.run_quiet(2, st))
+
+    t0 = time.perf_counter()
+    fin = jax.block_until_ready(engine.run_quiet(steps, warm))
+    dt = time.perf_counter() - t0
+
+    delivered = int(fin.delivered) - int(warm.delivered)
+    rate = delivered / dt
+    print(json.dumps({
+        "metric": f"token-ring dense delivered-messages/sec/chip @{n} nodes",
+        "value": round(rate, 1),
+        "unit": "msg/s",
+        "vs_baseline": round(rate / 1e8, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

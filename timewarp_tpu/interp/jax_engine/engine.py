@@ -288,9 +288,12 @@ class JaxEngine:
             np.asarray(ys.sent_hash)[m], np.asarray(ys.overflow)[m]))
         return final, SuperstepTrace.from_rows(rows)
 
-    @partial(jax.jit, static_argnums=(0, 2))
-    def _run_while(self, st: EngineState, max_steps: int) -> EngineState:
+    @partial(jax.jit, static_argnums=(0,))
+    def _run_while(self, st: EngineState, max_steps) -> EngineState:
+        # max_steps is traced (a device scalar), so benchmarking with
+        # different budgets reuses one compiled executable
         start_steps = st.steps  # max_steps is per-call, same as run()
+        max_steps = jnp.asarray(max_steps, jnp.int64)
 
         def cond(carry):
             mb_eff = jnp.where(carry.mb_valid, carry.mb_time, NEVER)
