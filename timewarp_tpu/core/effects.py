@@ -120,6 +120,46 @@ class ThrowTo(Effect):
     exc: BaseException
 
 
+@dataclass(frozen=True)
+class Park(Effect):
+    """Suspend this thread until some other thread :class:`Unpark`\\ s it;
+    yields back the value the unparker sent.
+
+    This effect pair plays the role STM plays under the reference (its
+    JobCurator blocks on ``TVar`` retries, Job.hs:48-49, 158-161; its
+    Transfer blocks on ``TBMChan``, Transfer.hs:236-242): the one
+    blocking primitive from which MVar/Channel/Flag are built
+    (:mod:`timewarp_tpu.manage.sync`). If an unpark token is already
+    pending, ``Park`` consumes it and continues immediately — no virtual
+    time passes — so the park/unpark race is benign.
+    """
+
+
+@dataclass(frozen=True)
+class Unpark(Effect):
+    """Wake a :class:`Park`\\ ed thread ``tid`` at the current instant,
+    sending it ``value``. If the target is not parked, the value is
+    stored as a token consumed by its next ``Park`` (last token wins).
+    No-op on dead/unknown threads."""
+    tid: Any
+    value: Any = None
+
+
+@dataclass(frozen=True)
+class AwaitIO(Effect):
+    """Await a real awaitable (coroutine/future) — **real-IO interpreter
+    only**; the pure emulator rejects it, because arbitrary host IO has
+    no deterministic virtual-time meaning. The TCP transport layer is
+    built on this; the emulated transport uses only timed effects and
+    therefore runs under both interpreters.
+
+    Cancellation contract: if the thread receives an async exception
+    (``throw_to``) while awaiting, the awaitable is cancelled and the
+    exception is raised at this yield point.
+    """
+    awaitable: Any
+
+
 # ----------------------------------------------------------------------
 # Derived combinators (generator helpers)
 # ----------------------------------------------------------------------
@@ -141,6 +181,20 @@ def my_thread_id() -> Program:
 def fork(program: ProgramFn) -> Program:
     """Fork; returns child ThreadId."""
     return (yield Fork(program))
+
+
+def park() -> Program:
+    """Suspend until unparked; returns the unparker's value."""
+    return (yield Park())
+
+
+def unpark(tid: Any, value: Any = None) -> Program:
+    yield Unpark(tid, value)
+
+
+def await_io(awaitable: Any) -> Program:
+    """Await real IO (real-IO interpreter only); returns its result."""
+    return (yield AwaitIO(awaitable))
 
 
 def fork_(program: ProgramFn) -> Program:

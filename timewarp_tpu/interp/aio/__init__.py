@@ -1,0 +1,6 @@
+"""Real wall-clock interpreter over asyncio (≙ ``TimedIO`` + the real
+``Transfer`` network, SURVEY.md §1 L1a/L3)."""
+
+from .timed import AioThreadId, RealTime, run_real_time
+
+__all__ = ["AioThreadId", "RealTime", "run_real_time"]

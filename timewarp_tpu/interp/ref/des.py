@@ -31,9 +31,12 @@ import logging
 from dataclasses import dataclass
 from typing import Any, Dict, List, Optional
 
-from ...core.effects import (Effect, Fork, GetLogName, GetTime, MyTid,
-                             Program, ProgramFn, SetLogName, ThrowTo, Wait)
-from ...core.errors import ThreadKilled
+from ...core.effects import (AwaitIO, Effect, Fork, GetLogName, GetTime,
+                             MyTid, Park, Program, ProgramFn, SetLogName,
+                             ThrowTo, Unpark, Wait)
+from ...core.errors import ThreadKilled, TimedError
+from ..common import NO_TOKEN as _NO_TOKEN
+from ..common import log_thread_death
 from ...core.time import Microsecond, resolve
 
 __all__ = ["PureEmulation", "PureThreadId", "run_emulation"]
@@ -50,6 +53,10 @@ class PureThreadId:
         return f"PureThreadId({self.n})"
 
 
+#: sentinel: no unpark token pending
+_NO_TOKEN = object()
+
+
 @dataclass
 class _Thread:
     tid: PureThreadId
@@ -60,6 +67,8 @@ class _Thread:
     alive: bool = True
     started: bool = False
     resume_entry: Optional[list] = None  # live queue entry, for wake-ups
+    parked: bool = False
+    park_token: Any = _NO_TOKEN           # pending unpark value
 
 
 # Queue entry layout: [time, seq, tid, send_value, cancelled]
@@ -204,6 +213,22 @@ class PureEmulation:
                     value = th.log_name
                 elif type(eff) is SetLogName:
                     th.log_name = eff.name
+                elif type(eff) is Park:
+                    if th.park_token is not _NO_TOKEN:
+                        # pending token: consume, continue instantly
+                        value, th.park_token = th.park_token, _NO_TOKEN
+                    else:
+                        th.parked = True
+                        return  # no queue entry until unparked/thrown-to
+                elif type(eff) is Unpark:
+                    self._unpark(eff.tid, eff.value)
+                elif type(eff) is AwaitIO:
+                    # thrown *into* the program (catchable), not out of
+                    # the interpreter
+                    exc = TimedError(
+                        "AwaitIO (real host IO) has no meaning under pure "
+                        "emulation; use the real-IO interpreter or the "
+                        "emulated transport")
                 else:
                     raise TypeError(f"unknown effect: {eff!r}")
         except StopIteration as stop:
@@ -212,13 +237,27 @@ class PureEmulation:
         except BaseException as e:  # noqa: BLE001 — interpreter boundary
             self._finish(th, e, main_result, main_error)
 
+    def _unpark(self, tid: PureThreadId, value: Any) -> None:
+        th = self._threads.get(tid)
+        if th is None or not th.alive:
+            return
+        if th.parked:
+            th.parked = False
+            self._push(th, self._time, value)
+        else:
+            th.park_token = value  # consumed by the next Park
+
     def _throw_to(self, tid: PureThreadId, exc: BaseException) -> None:
         """≙ throwTo (TimedT.hs:357-368): wake the target to `now`, then
         store the exception — first thrower wins (TimedT.hs:359)."""
         th = self._threads.get(tid)
         if th is None or not th.alive:
             return
-        if th.resume_entry is not None and th.resume_entry[_TIME] > self._time:
+        if th.parked:
+            th.parked = False
+            self._push(th, self._time, None)
+        elif (th.resume_entry is not None
+              and th.resume_entry[_TIME] > self._time):
             th.resume_entry[_CANCELLED] = True
             self._push(th, self._time, th.resume_entry[_VALUE])
         self._pending_exc.setdefault(tid, exc)

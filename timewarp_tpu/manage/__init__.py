@@ -1,0 +1,6 @@
+"""Lifecycle & coordination: sync primitives over the effect API and the
+job manager (≙ ``Control.TimeWarp.Manager``, SURVEY.md §1 L2)."""
+
+from .sync import CLOSED, Channel, Flag, MVar
+
+__all__ = ["CLOSED", "Channel", "Flag", "MVar"]
