@@ -105,6 +105,19 @@ class Scenario:
     max_out: int = 1
     mailbox_cap: int = 8
     init_batched: Optional[InitBatchedFn] = None
+    #: whether ``step`` consumes its entropy argument (core/rng.py
+    #: ``fire_bits`` pair); engines skip deriving it when False
+    needs_key: bool = False
+    #: static communication graph: int32 [N, M] destination of each
+    #: outbox slot (-1 = slot never used), when the scenario only ever
+    #: sends along fixed edges. Enables the sort/scatter-free edge
+    #: engine (interp/jax_engine/edge_engine.py).
+    static_dst: Optional[Any] = None
+    #: True when ``step`` is insensitive to inbox slot *order* (it
+    #: reduces over the inbox commutatively). Lets engines skip the
+    #: contract-#2 inbox sort; parity still holds bit-for-bit because
+    #: digests are order-independent and the step result is too.
+    commutative_inbox: bool = False
     #: metadata for bench/trace tooling
     meta: dict = field(default_factory=dict)
 

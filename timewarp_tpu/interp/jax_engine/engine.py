@@ -39,11 +39,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ...core.rng import fire_bits, msg_bits, seed_words
 from ...core.scenario import NEVER, Inbox, Scenario
 from ...net.delays import LinkModel
 from ...trace.events import SuperstepTrace
 from ...trace.hashing import FIRED, RECV, SENT, mix32_jnp
-from .rng import fire_key, msg_key
 
 __all__ = ["JaxEngine", "EngineState"]
 
@@ -99,7 +99,7 @@ class JaxEngine:
                  seed: int = 0) -> None:
         self.scenario = scenario
         self.link = link
-        self.key = jax.random.PRNGKey(seed)
+        self.s0, self.s1 = seed_words(seed)
 
     # -- initial state ---------------------------------------------------
 
@@ -165,11 +165,13 @@ class JaxEngine:
                 0),
         )
 
-        # 4. fire every node simultaneously; mask non-fired results
-        keys = jax.vmap(lambda i: fire_key(self.key, i, t))(node_ids)
+        # 4. fire every node simultaneously; mask non-fired results.
+        # Entropy is derived elementwise (core/rng.py) — no key arrays.
+        bits = fire_bits(self.s0, self.s1, node_ids, t) \
+            if sc.needs_key else None
         new_states, out, new_wake = jax.vmap(
-            sc.step, in_axes=(0, 0, None, 0, 0))(
-                st.states, inbox, t, node_ids, keys)
+            sc.step, in_axes=(0, 0, None, 0, None if bits is None else 0))(
+                st.states, inbox, t, node_ids, bits)
         states = jax.tree.map(
             lambda a, b: jnp.where(
                 fire.reshape((n,) + (1,) * (b.ndim - 1)), b, a),
@@ -196,10 +198,9 @@ class JaxEngine:
         dst_f = out.dst.reshape(S).astype(jnp.int32)
         pay_f = out.payload.reshape(S, P)
         v_f = out_valid.reshape(S)
-        mkeys = jax.vmap(lambda s, d, sl: msg_key(self.key, s, d, t, sl))(
-            src_f, dst_f, slot_f)
-        delay, drop = jax.vmap(
-            lambda s, d, k: self.link.sample(s, d, t, k))(src_f, dst_f, mkeys)
+        mbits = msg_bits(self.s0, self.s1, src_f, dst_f, t, slot_f) \
+            if self.link.needs_key else None
+        delay, drop = self.link.sample(src_f, dst_f, t, mbits)
         dst_ok = (dst_f >= 0) & (dst_f < n)
         ok = v_f & ~drop & dst_ok
         # contract #6 corollary: a scenario emitting an out-of-range

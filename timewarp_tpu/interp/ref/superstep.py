@@ -31,12 +31,12 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ...core.rng import fire_bits, msg_bits, seed_words
 from ...core.scenario import NEVER, Inbox, Scenario
 from ...core.time import Microsecond
 from ...net.delays import LinkModel
 from ...trace.events import SuperstepTrace
 from ...trace.hashing import FIRED, RECV, SENT, combine_py, mix32_py
-from ..jax_engine.rng import fire_key, msg_key
 
 __all__ = ["SuperstepOracle"]
 
@@ -50,7 +50,7 @@ class SuperstepOracle:
                  seed: int = 0) -> None:
         self.scenario = scenario
         self.link = link
-        self.key = jax.random.PRNGKey(seed)
+        self.s0, self.s1 = seed_words(seed)
         n = scenario.n_nodes
         per = [scenario.init(i) for i in range(n)]
         #: stacked numpy state pytree (row i = node i)
@@ -69,20 +69,28 @@ class SuperstepOracle:
         src_f = jnp.repeat(ids, M)
         slot_f = jnp.tile(jnp.arange(M, dtype=jnp.int32), n)
 
-        # one vmapped step per superstep — same fn the engine vmaps
+        # one vmapped step per superstep — same fn the engine vmaps;
+        # entropy derived elementwise (core/rng.py), no key arrays
         def _vstep(states, inbox, t):
-            keys = jax.vmap(lambda i: fire_key(self.key, i, t))(ids)
-            return jax.vmap(scenario.step, in_axes=(0, 0, None, 0, 0))(
-                states, inbox, t, ids, keys)
+            if scenario.needs_key:
+                bits = fire_bits(self.s0, self.s1, ids, t)
+            else:
+                bits = None
+            return jax.vmap(
+                scenario.step,
+                in_axes=(0, 0, None, 0, None if bits is None else 0))(
+                    states, inbox, t, ids, bits)
 
         self._vstep = jax.jit(_vstep)
 
-        # one batched link sample per superstep, keyed per (src,dst,t,slot)
+        # one batched link sample per superstep, keyed per (src,dst,t,slot);
+        # link models broadcast — no vmap needed
         def _vsample(dst, t):
-            keys = jax.vmap(lambda s, d, sl: msg_key(self.key, s, d, t, sl))(
-                src_f, dst, slot_f)
-            return jax.vmap(lambda s, d, k: link.sample(s, d, t, k))(
-                src_f, dst, keys)
+            if link.needs_key:
+                bits = msg_bits(self.s0, self.s1, src_f, dst, t, slot_f)
+            else:
+                bits = None
+            return link.sample(src_f, dst, t, bits)
 
         self._vsample = jax.jit(_vsample)
 

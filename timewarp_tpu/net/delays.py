@@ -4,9 +4,16 @@ Revives the reference's removed fault-injection surface — ``Delays`` /
 ``ConnectionOutcome`` (examples/token-ring/Main.hs:73-77; the README's
 promised "manually controlled network nastiness", README.md:13-15) — as
 first-class, *batchable* models: a link model is a pure function from
-``(src, dst, send_time, key)`` to ``(delay_µs, drop)``, written in
-jax.numpy so the same code vmaps over millions of messages on TPU and
-evaluates per-message in the host oracle with identical bits.
+``(src, dst, send_time, entropy)`` to ``(delay_µs, drop)``, written in
+elementwise jax.numpy so the same code broadcasts over millions of
+messages on TPU — in whatever layout the engine already holds them —
+and evaluates per-message in the host oracle with identical bits.
+
+Entropy is a pair of uint32 words from :mod:`timewarp_tpu.core.rng`
+(counter-derived per message, never a materialized key array — see
+profiling/superstep_breakdown.md for why). Models that use no
+randomness declare ``needs_key = False`` so engines skip deriving
+entropy entirely.
 
 All delays are int64 µs; the engine clamps in-flight time to ≥ 1 µs
 (determinism contract #4, core/scenario.py).
@@ -20,6 +27,8 @@ from typing import Callable, Tuple
 import jax
 import jax.numpy as jnp
 
+from ..core.rng import bernoulli, normal_f32, split_bits, uniform_int
+
 __all__ = [
     "LinkModel", "FixedDelay", "UniformDelay", "LogNormalDelay",
     "WithDrop", "FnDelay", "NEVER_CONNECTED",
@@ -30,7 +39,12 @@ NEVER_CONNECTED = 1.0
 
 
 class LinkModel:
-    """Base class. ``sample`` must be jittable (scalar jnp ops only)."""
+    """Base class. ``sample`` must be jittable (broadcasting jnp ops
+    only). ``key`` is an ``(uint32, uint32)`` entropy pair (``None``
+    when ``needs_key`` is False)."""
+
+    #: whether ``sample`` consumes entropy; engines skip derivation if not
+    needs_key: bool = True
 
     def sample(self, src, dst, t, key) -> Tuple[jax.Array, jax.Array]:
         """-> (delay int64 µs, drop bool)."""
@@ -41,9 +55,11 @@ class LinkModel:
 class FixedDelay(LinkModel):
     """Every message takes exactly ``delay`` µs (≙ ``ConnectedIn d``)."""
     delay: int
+    needs_key = False
 
     def sample(self, src, dst, t, key):
-        return jnp.asarray(self.delay, jnp.int64), jnp.asarray(False)
+        d = jnp.full(jnp.shape(dst), self.delay, jnp.int64)
+        return d, jnp.zeros(jnp.shape(dst), bool)
 
 
 @dataclass(frozen=True)
@@ -55,8 +71,9 @@ class UniformDelay(LinkModel):
     hi: int
 
     def sample(self, src, dst, t, key):
-        d = jax.random.randint(key, (), self.lo, self.hi + 1, dtype=jnp.int32)
-        return jnp.asarray(d, jnp.int64), jnp.asarray(False)
+        b0, _ = key
+        return uniform_int(b0, self.lo, self.hi), \
+            jnp.zeros(jnp.shape(dst), bool)
 
 
 @dataclass(frozen=True)
@@ -74,33 +91,37 @@ class LogNormalDelay(LinkModel):
     cap_us: int = 60_000_000
 
     def sample(self, src, dst, t, key):
-        z = jax.random.normal(key, (), dtype=jnp.float32)
+        b0, b1 = key
+        z = normal_f32(b0, b1)
         d = jnp.asarray(self.median_us, jnp.float32) * jnp.exp(
             jnp.float32(self.sigma) * z)
         d = jnp.clip(d, 1.0, jnp.float32(self.cap_us))
-        return jnp.asarray(jnp.round(d), jnp.int64), jnp.asarray(False)
+        return jnp.asarray(jnp.round(d), jnp.int64), \
+            jnp.zeros(jnp.shape(dst), bool)
 
 
 @dataclass(frozen=True)
 class WithDrop(LinkModel):
     """Wrap a model with i.i.d. message loss — the "nastiness" knob
     (socket-state-with-drop baseline config). ``drop_prob=1`` ≙ the old
-    ``NeverConnected`` outcome."""
+    ``NeverConnected`` outcome. The drop decision is an integer
+    threshold compare — bit-exact everywhere."""
     inner: LinkModel
     drop_prob: float
 
     def sample(self, src, dst, t, key):
-        k_drop, k_inner = jax.random.split(key)
-        drop = jax.random.bernoulli(k_drop, jnp.float32(self.drop_prob))
-        delay, inner_drop = self.inner.sample(src, dst, t, k_inner)
+        b0, b1 = key
+        drop = bernoulli(b0, self.drop_prob)
+        inner_key = split_bits(b0, b1, 0x1A7E5EED)
+        delay, inner_drop = self.inner.sample(src, dst, t, inner_key)
         return delay, drop | inner_drop
 
 
 @dataclass(frozen=True)
 class FnDelay(LinkModel):
     """Arbitrary per-link behavior from a user function
-    ``fn(src, dst, t, key) -> (delay, drop)`` in jnp scalar ops — the
-    full generality of the old ``Delays`` newtype (a function of
+    ``fn(src, dst, t, key) -> (delay, drop)`` in broadcasting jnp ops —
+    the full generality of the old ``Delays`` newtype (a function of
     destination and time, examples/token-ring/Main.hs:73-77)."""
     fn: Callable
 
