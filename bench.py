@@ -3,15 +3,18 @@
 The flagship workload is the reference's north-star scenario
 (`/root/reference/examples/token-ring/Main.hs`) generalized to a dense
 ring — every node holds a token, so each superstep fires all N nodes and
-delivers N messages (the regime the BASELINE.json target describes:
-delivered-messages/sec/chip at large N).
+delivers N messages — at the BASELINE.json target scale (1M simulated
+nodes, delivered-messages/sec/chip, target >= 1e8).
+
+Runs on the edge engine (interp/jax_engine/edge_engine.py): the ring's
+static topology makes delivery a fused neighbor shift — no sort, no
+scatter (profiling/superstep_breakdown.md).
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
-``vs_baseline`` is value / 1e8 (the BASELINE.json north-star target of
->= 1e8 delivered msgs/sec/chip; the reference itself publishes no
-numbers — BASELINE.md).
+``vs_baseline`` is value / 1e8 (the north-star target; the reference
+itself publishes no numbers — BASELINE.md).
 
-Env knobs: TW_BENCH_NODES (default 65536), TW_BENCH_STEPS (default 256).
+Env knobs: TW_BENCH_NODES (default 1048576), TW_BENCH_STEPS (default 256).
 """
 
 import json
@@ -22,13 +25,13 @@ from timewarp_tpu.utils import jaxconfig  # noqa: F401
 
 import jax
 
-from timewarp_tpu.interp.jax_engine.engine import JaxEngine
+from timewarp_tpu.interp.jax_engine.edge_engine import EdgeEngine
 from timewarp_tpu.models.token_ring import token_ring
 from timewarp_tpu.net.delays import FixedDelay
 
 
 def main() -> None:
-    n = int(os.environ.get("TW_BENCH_NODES", 65536))
+    n = int(os.environ.get("TW_BENCH_NODES", 1 << 20))
     steps = int(os.environ.get("TW_BENCH_STEPS", 256))
 
     # Dense ring, think_us=0: a node receiving a token forwards it in
@@ -37,19 +40,20 @@ def main() -> None:
     sc = token_ring(
         n, n_tokens=n, think_us=0, bootstrap_us=1_000,
         end_us=(1 << 50), with_observer=False, mailbox_cap=4)
-    engine = JaxEngine(sc, FixedDelay(500))
+    engine = EdgeEngine(sc, FixedDelay(500), cap=2)
 
     st = engine.init_state()
     st = jax.block_until_ready(st)
 
     # Warmup: compile the while_loop driver (first TPU compile 20-40 s).
-    warm = jax.block_until_ready(engine.run_quiet(2, st))
+    warm = engine.run_quiet(2, st)
+    int(warm.delivered)  # force completion via host readback
 
     t0 = time.perf_counter()
-    fin = jax.block_until_ready(engine.run_quiet(steps, warm))
+    fin = engine.run_quiet(steps, warm)
+    delivered = int(fin.delivered) - int(warm.delivered)  # forces readback
     dt = time.perf_counter() - t0
 
-    delivered = int(fin.delivered) - int(warm.delivered)
     rate = delivered / dt
     print(json.dumps({
         "metric": f"token-ring dense delivered-messages/sec/chip @{n} nodes",

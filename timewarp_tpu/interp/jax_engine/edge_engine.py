@@ -1,0 +1,416 @@
+"""Edge engine: sort/scatter-free batched execution for static topologies.
+
+The general engine (engine.py) routes messages with a global
+stable-argsort + searchsorted + 4 mailbox scatters per superstep; on
+TPU those are the entire cost (profiling/superstep_breakdown.md:
+random scatter ≈ 1 ms/131k updates, int64 scatter ≈ 15 ms, while
+elementwise/sort work is ~free). When the communication graph is
+*static* — every outbox slot always targets the same destination
+(``Scenario.static_dst``) — routing needs none of that:
+
+- the graph is inverted **on the host** into per-node in-edge tables;
+- per-edge bounded queues hold in-flight messages in ``[E, C, N]``
+  layout (minor dim = node axis: no lane padding, perfect VPU tiling);
+- delivery moves each sender's outbox slot to its receiver's edge
+  queue by a *static* index map — a gather, and for pure-shift
+  topologies (the ring: ``dst = (i+1) mod N``) ``jnp.roll``, which XLA
+  fuses into the surrounding elementwise work;
+- queue insert/remove are one-hot elementwise updates over the static
+  capacity axis ``C`` — no scatter anywhere.
+
+This is the reference's event loop (TimedT.hs:234-286) specialized the
+TPU way: the priority queue becomes per-edge arrival buffers whose
+minimum is a masked reduction.
+
+Semantics match core/scenario.py's superstep contract with one scoped
+difference: capacity is **per edge** (``cap`` messages in flight per
+(src,slot)→dst edge) rather than per-node ``mailbox_cap``. Overflow is
+still counted and dropped, never silent; trace parity with the oracle
+is bit-for-bit in all no-overflow regimes (the parity tests assert
+overflow == 0), which is the regime the capacity declarations are for.
+
+Inbox ordering: for ``commutative_inbox`` scenarios the inbox is
+presented unsorted (the step result and the order-independent digests
+are invariant to slot order, so parity holds bit-for-bit); otherwise
+one variadic ``lax.sort`` along the slot axis — cheap in this layout —
+restores contract #2's ``(deliver_time, insert_step, sender-major)``
+order.
+
+Delays must fit int32 µs (< ~35 min): queue times are stored relative
+to the engine's rebased epoch so no int64 ever needs scattering (or
+storing per-slot).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, List, NamedTuple, Optional, Tuple
+
+from ...utils import jaxconfig  # noqa: F401
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.rng import fire_bits, msg_bits, seed_words
+from ...core.scenario import NEVER, Inbox, Outbox, Scenario
+from ...net.delays import LinkModel
+from ...trace.events import SuperstepTrace
+from ...trace.hashing import FIRED, RECV, SENT, mix32_jnp
+from .engine import _StepOut, _thi, _tlo, _u32sum
+
+__all__ = ["EdgeEngine", "EdgeState", "EdgeTopology"]
+
+_I32MAX = np.int32(2**31 - 1)
+
+
+class EdgeTopology(NamedTuple):
+    """Host-side inversion of ``Scenario.static_dst`` (int32 [N, M],
+    -1 = unused slot) into receiver-centric in-edge tables.
+
+    Edge index ``e`` within a node is its sender-major rank — the
+    arrival-order tie-break of determinism contract #3 falls out of
+    the table construction.
+    """
+    n_edges: int               # E = max in-degree
+    in_valid: np.ndarray       # bool [E, N] — edge exists
+    in_src: np.ndarray         # int32 [E, N] — sender (0 where invalid)
+    in_slot: np.ndarray        # int32 [E, N] — sender's outbox slot
+    in_flat: np.ndarray        # int32 [E, N] — slot*N + src, for 1D gather
+    shift: List[Optional[Tuple[int, int]]]  # per edge: (roll, slot) or None
+
+    @staticmethod
+    def build(static_dst: np.ndarray, n: int) -> "EdgeTopology":
+        sd = np.asarray(static_dst, np.int32)
+        if sd.shape[0] != n:
+            raise ValueError(f"static_dst rows {sd.shape[0]} != n_nodes {n}")
+        used = sd >= 0
+        if np.any(sd[used] >= n):
+            raise ValueError("static_dst contains out-of-range destination")
+        M = sd.shape[1]
+        # vectorized graph inversion: flatten (src, slot) pairs, order by
+        # (dst, src, slot) — sender-major within each receiver
+        flat = sd.ravel()
+        srcs = np.repeat(np.arange(n, dtype=np.int32), M)
+        slots = np.tile(np.arange(M, dtype=np.int32), n)
+        mask = flat >= 0
+        d, s, sl = flat[mask], srcs[mask], slots[mask]
+        if d.size == 0:
+            raise ValueError("static_dst declares no edges")
+        o = np.lexsort((sl, s, d))
+        d, s, sl = d[o], s[o], sl[o]
+        starts = np.searchsorted(d, np.arange(n, dtype=np.int32))
+        e_idx = np.arange(d.size, dtype=np.int64) - starts[d]
+        E = int(e_idx.max()) + 1
+        in_valid = np.zeros((E, n), bool)
+        in_src = np.zeros((E, n), np.int32)
+        in_slot = np.zeros((E, n), np.int32)
+        in_valid[e_idx, d] = True
+        in_src[e_idx, d] = s
+        in_slot[e_idx, d] = sl
+        in_flat = in_slot * np.int32(n) + in_src
+        # pure-shift detection: edge e is src = (i - s) mod N for all i
+        shift: List[Optional[Tuple[int, int]]] = []
+        ids = np.arange(n, dtype=np.int64)
+        for e in range(E):
+            if in_valid[e].all() and (in_slot[e] == in_slot[e, 0]).all():
+                d = (ids - in_src[e]) % n
+                if (d == d[0]).all():
+                    shift.append((int(d[0]), int(in_slot[e, 0])))
+                    continue
+            shift.append(None)
+        return EdgeTopology(E, in_valid, in_src, in_slot, in_flat, shift)
+
+
+class EdgeState(NamedTuple):
+    """Complete simulation state — one pytree, checkpointable and
+    shardable. Queue axes: [E edges, C capacity, N nodes]."""
+    states: Any            # scenario pytree, leading dim N
+    wake: jax.Array        # int64[N]
+    q_rel: jax.Array       # int32[E, C, N] — deliver time minus `time`
+    q_step: jax.Array      # int32[E, C, N] — insertion superstep
+    q_pay: jax.Array       # int32[E, C, P, N]
+    q_valid: jax.Array     # bool[E, C, N]
+    overflow: jax.Array    # int32[]
+    unrouted: jax.Array    # int32[] — valid sends on undeclared slots
+    bad_delay: jax.Array   # int32[] — delays >= 2^31 µs, clamped
+    delivered: jax.Array   # int64[]
+    steps: jax.Array       # int64[]
+    time: jax.Array        # int64[] — current virtual time == queue epoch
+
+
+class EdgeEngine:
+    """Batched engine for static-topology scenarios. Same driver API as
+    :class:`~timewarp_tpu.interp.jax_engine.engine.JaxEngine`: ``run``
+    (traced, per-superstep rows) and ``run_quiet`` (while_loop, no
+    trace work compiled in)."""
+
+    def __init__(self, scenario: Scenario, link: LinkModel, *,
+                 seed: int = 0, cap: int = 2) -> None:
+        if scenario.static_dst is None:
+            raise ValueError(
+                f"scenario {scenario.name!r} declares no static_dst; "
+                "use the general JaxEngine")
+        self.scenario = scenario
+        self.link = link
+        self.s0, self.s1 = seed_words(seed)
+        self.cap = cap
+        self.topo = EdgeTopology.build(scenario.static_dst,
+                                       scenario.n_nodes)
+
+    # -- initial state ---------------------------------------------------
+
+    def init_state(self) -> EdgeState:
+        sc = self.scenario
+        n, E, C, P = sc.n_nodes, self.topo.n_edges, self.cap, \
+            sc.payload_width
+        if sc.init_batched is not None:
+            states, wake = sc.init_batched(n)
+            wake = jnp.asarray(wake, jnp.int64)
+        else:
+            per = [sc.init(i) for i in range(n)]
+            states = jax.tree.map(
+                lambda *xs: jnp.stack([jnp.asarray(x) for x in xs]),
+                *[p[0] for p in per])
+            wake = jnp.asarray([p[1] for p in per], jnp.int64)
+        return EdgeState(
+            states=states,
+            wake=wake,
+            q_rel=jnp.full((E, C, n), _I32MAX, jnp.int32),
+            q_step=jnp.zeros((E, C, n), jnp.int32),
+            q_pay=jnp.zeros((E, C, P, n), jnp.int32),
+            q_valid=jnp.zeros((E, C, n), bool),
+            overflow=jnp.int32(0),
+            unrouted=jnp.int32(0),
+            bad_delay=jnp.int32(0),
+            delivered=jnp.int64(0),
+            steps=jnp.int64(0),
+            time=jnp.int64(0),
+        )
+
+    # -- one superstep ---------------------------------------------------
+
+    def _superstep(self, st: EdgeState, with_trace: bool
+                   ) -> Tuple[EdgeState, Optional[_StepOut]]:
+        sc, topo = self.scenario, self.topo
+        n, E, C, P = sc.n_nodes, topo.n_edges, self.cap, sc.payload_width
+        W = E * C
+        node_ids = jnp.arange(n, dtype=jnp.int32)
+        base = st.time
+
+        # 1. global next event time (the batched "pop min")
+        qeff = jnp.where(st.q_valid, st.q_rel, _I32MAX)     # [E,C,N]
+        nnr = qeff.min(axis=(0, 1))                          # int32[N]
+        node_next = jnp.minimum(
+            st.wake,
+            jnp.where(nnr == _I32MAX, jnp.int64(NEVER),
+                      base + nnr.astype(jnp.int64)))
+        t = node_next.min()
+        live = t < NEVER
+        fire = (node_next == t) & live
+
+        # 2. deliverable messages (all per-edge slots due at fired nodes)
+        shift32 = jnp.minimum(t - base,
+                              jnp.int64(_I32MAX - 1)).astype(jnp.int32)
+        deliver = st.q_valid & (st.q_rel <= shift32) & fire[None, None, :]
+
+        # 3. inbox [W, N] — slot-axis views of the queues (leading-axis
+        #    reshape: no relayout)
+        iv = deliver.reshape(W, n)
+        rel = jnp.where(iv, st.q_rel.reshape(W, n), _I32MAX)
+        istep = st.q_step.reshape(W, n)
+        isrc = jnp.broadcast_to(
+            jnp.asarray(topo.in_src)[:, None, :], (E, C, n)).reshape(W, n)
+        ipay = st.q_pay.reshape(W, P, n)
+        if not sc.commutative_inbox:
+            # contract #2 order: (deliver_time, insert_step, sender-major
+            # edge rank); one variadic sort along the slot axis
+            erank = jnp.broadcast_to(
+                jnp.arange(E, dtype=jnp.int32)[:, None, None],
+                (E, C, n)).reshape(W, n)
+            ops = jax.lax.sort(
+                (~iv, rel, istep, erank, isrc) + tuple(
+                    ipay[:, p, :] for p in range(P)),
+                dimension=0, num_keys=4)
+            iv, rel, isrc = ~ops[0], ops[1], ops[4]
+            ipay = jnp.stack(ops[5:5 + P], axis=1)
+        itime = jnp.where(iv, base + rel.astype(jnp.int64),
+                          jnp.int64(NEVER))
+        inbox = Inbox(
+            valid=iv,
+            src=jnp.where(iv, isrc, 0),
+            time=itime,
+            payload=jnp.where(iv[:, None, :], ipay, 0),
+        )
+
+        # 4. fire every node; batch axis is the *minor* dim for inbox and
+        #    outbox leaves (no [N, small] padding anywhere)
+        bits = fire_bits(self.s0, self.s1, node_ids, t) \
+            if sc.needs_key else None
+        new_states, out, new_wake = jax.vmap(
+            sc.step,
+            in_axes=(0, Inbox(valid=-1, src=-1, time=-1, payload=-1),
+                     None, 0, None if bits is None else 0),
+            out_axes=(0, Outbox(valid=-1, dst=-1, payload=-1), 0))(
+                st.states, inbox, t, node_ids, bits)
+        states = jax.tree.map(
+            lambda a, b: jnp.where(
+                fire.reshape((n,) + (1,) * (b.ndim - 1)), b, a),
+            st.states, new_states)
+        new_wake = jnp.where(new_wake >= NEVER, NEVER,
+                             jnp.maximum(new_wake, t + 1))  # contract #5
+        wake = jnp.where(fire, new_wake, st.wake)
+        out_valid = out.valid & fire[None, :]               # [M, N]
+        out_pay = out.payload                                # [M, P, N]
+        # never-silent contract: a valid send on a slot whose static_dst
+        # is -1 has nowhere to go — counted (≙ JaxEngine's bad_dst)
+        declared = jnp.asarray(
+            (np.asarray(sc.static_dst, np.int32) >= 0).T)    # [M, N]
+        unrouted_step = jnp.sum(out_valid & ~declared, dtype=jnp.int32)
+
+        # 5. rebase surviving queue entries to the new epoch t
+        keep = st.q_valid & ~deliver
+        q_rel = jnp.where(keep, st.q_rel - shift32, _I32MAX)
+        q_step = st.q_step
+        q_pay = st.q_pay
+        q_valid = keep
+
+        # 6-7. route + enqueue, one static in-edge at a time — gathers
+        # only on non-shift edges, never a scatter
+        step32 = st.steps.astype(jnp.int32)
+        overflow_step = jnp.int32(0)
+        bad_delay_total = jnp.int32(0)
+        sent_count = jnp.int32(0)
+        sent_hash = jnp.uint32(0)
+        for e in range(E):
+            sh = topo.shift[e]
+            if sh is not None:
+                s, slot = sh
+                arr_v = jnp.roll(out_valid[slot], s)
+                arr_p = jnp.roll(out_pay[slot], s, axis=-1)  # [P, N]
+            else:
+                flat_idx = jnp.asarray(topo.in_flat[e])
+                arr_v = out_valid.reshape(-1)[flat_idx] \
+                    & jnp.asarray(topo.in_valid[e])
+                arr_p = out_pay.transpose(1, 0, 2).reshape(P, -1)[
+                    :, flat_idx]
+            src_e = jnp.asarray(topo.in_src[e])
+            slot_e = jnp.asarray(topo.in_slot[e])
+            mb = msg_bits(self.s0, self.s1, src_e, node_ids, t, slot_e) \
+                if self.link.needs_key else None
+            delay, drop = self.link.sample(src_e, node_ids, t, mb)
+            ok = arr_v & ~drop
+            drel64 = jnp.maximum(delay, jnp.int64(1))       # contract #4
+            # queue times are int32-relative; a >= 2^31 µs delay cannot
+            # be represented — clamp and count, never wrap silently
+            bad_delay_step = jnp.sum(
+                ok & (drel64 > jnp.int64(_I32MAX - 1)), dtype=jnp.int32)
+            bad_delay_total = bad_delay_total + bad_delay_step
+            drel = jnp.minimum(
+                drel64, jnp.int64(_I32MAX - 1)).astype(jnp.int32)
+            if with_trace:
+                dt_abs = t + jnp.maximum(delay, jnp.int64(1))
+                smix = mix32_jnp(SENT, src_e, node_ids, _tlo(dt_abs),
+                                 _thi(dt_abs), arr_p[0])
+                sent_hash = sent_hash + _u32sum(jnp.where(ok, smix, 0))
+                sent_count = sent_count + jnp.sum(ok, dtype=jnp.int32)
+            # first-free-slot one-hot insert over the static C axis
+            free = ~q_valid[e]                               # [C, N]
+            cids = jnp.arange(C, dtype=jnp.int32)[:, None]
+            ff = jnp.where(free, cids, C).min(axis=0)        # int32[N]
+            ins = ok[None, :] & (cids == ff)                 # [C, N]
+            q_rel = q_rel.at[e].set(
+                jnp.where(ins, drel, q_rel[e]))
+            q_step = q_step.at[e].set(
+                jnp.where(ins, step32, q_step[e]))
+            q_valid = q_valid.at[e].set(q_valid[e] | ins)
+            q_pay = q_pay.at[e].set(
+                jnp.where(ins[:, None, :], arr_p[None, :, :], q_pay[e]))
+            overflow_step = overflow_step + jnp.sum(
+                ok & (ff == C), dtype=jnp.int32)
+
+        recv_count = jnp.sum(deliver, dtype=jnp.int32)
+        new_st = EdgeState(
+            states=states, wake=wake,
+            q_rel=q_rel, q_step=q_step, q_pay=q_pay, q_valid=q_valid,
+            overflow=st.overflow + overflow_step,
+            unrouted=st.unrouted + unrouted_step,
+            bad_delay=st.bad_delay + bad_delay_total,
+            delivered=st.delivered + recv_count.astype(jnp.int64),
+            steps=st.steps + 1,
+            time=t,
+        )
+        final = jax.tree.map(lambda a, b: jnp.where(live, b, a), st, new_st)
+        if not with_trace:
+            return final, None
+
+        # 8. trace digests (order-independent; computed pre-sort from the
+        # deliver mask — identical to the sorted-inbox digest by
+        # commutativity of the uint32 sum)
+        fired_hash = _u32sum(jnp.where(fire, mix32_jnp(FIRED, node_ids), 0))
+        d_abs = base + jnp.where(deliver, st.q_rel, 0).astype(jnp.int64)
+        rmix = mix32_jnp(
+            RECV, jnp.broadcast_to(node_ids, (E, C, n)),
+            jnp.broadcast_to(jnp.asarray(topo.in_src)[:, None, :],
+                             (E, C, n)),
+            _tlo(d_abs), _thi(d_abs), st.q_pay[:, :, 0, :])
+        recv_hash = _u32sum(jnp.where(deliver, rmix, 0))
+        yrow = _StepOut(
+            valid=live, t=t,
+            fired_count=jnp.sum(fire, dtype=jnp.int32),
+            fired_hash=fired_hash,
+            recv_count=recv_count, recv_hash=recv_hash,
+            sent_count=sent_count, sent_hash=sent_hash,
+            overflow=overflow_step,
+        )
+        yrow = jax.tree.map(
+            lambda x: jnp.where(live, x, jnp.zeros_like(x)), yrow)
+        return final, yrow
+
+    # -- drivers ---------------------------------------------------------
+
+    @partial(jax.jit, static_argnums=(0, 2))
+    def _run_scan(self, st: EdgeState, max_steps: int):
+        def body(carry, _):
+            return self._superstep(carry, True)
+        return jax.lax.scan(body, st, None, length=max_steps)
+
+    def run(self, max_steps: int,
+            state: Optional[EdgeState] = None
+            ) -> Tuple[EdgeState, SuperstepTrace]:
+        st = state if state is not None else self.init_state()
+        final, ys = self._run_scan(st, max_steps)
+        ys = jax.device_get(ys)
+        m = np.asarray(ys.valid)
+        rows = list(zip(
+            np.asarray(ys.t)[m], np.asarray(ys.fired_count)[m],
+            np.asarray(ys.fired_hash)[m], np.asarray(ys.recv_count)[m],
+            np.asarray(ys.recv_hash)[m], np.asarray(ys.sent_count)[m],
+            np.asarray(ys.sent_hash)[m], np.asarray(ys.overflow)[m]))
+        return final, SuperstepTrace.from_rows(rows)
+
+    @partial(jax.jit, static_argnums=(0,))
+    def _run_while(self, st: EdgeState, max_steps) -> EdgeState:
+        start_steps = st.steps
+        max_steps = jnp.asarray(max_steps, jnp.int64)
+
+        def cond(carry):
+            qmin = jnp.where(carry.q_valid, carry.q_rel, _I32MAX).min()
+            has_q = qmin < _I32MAX
+            nxt = jnp.minimum(
+                carry.wake.min(),
+                jnp.where(has_q, carry.time + qmin.astype(jnp.int64),
+                          jnp.int64(NEVER)))
+            return (nxt < NEVER) & (carry.steps - start_steps < max_steps)
+
+        def body(carry):
+            return self._superstep(carry, False)[0]
+
+        return jax.lax.while_loop(cond, body, st)
+
+    def run_quiet(self, max_steps: int,
+                  state: Optional[EdgeState] = None) -> EdgeState:
+        """Traceless driver: one ``while_loop``, digests and counts not
+        even compiled in."""
+        st = state if state is not None else self.init_state()
+        return self._run_while(st, max_steps)

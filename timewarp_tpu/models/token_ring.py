@@ -16,20 +16,27 @@ Generalizations over the reference (used by bench configs):
 
 - ``n_tokens`` initial tokens (reference: 1). With ``n_tokens == n_ring``
   every node forwards a token every superstep — the dense ring exchange
-  that maps onto the TPU as a pure neighbor ``ppermute``.
+  that maps onto the TPU as a pure neighbor shift.
 - a node holding several tokens forwards them one per think-interval
   (a bounded queue, like the reference's serialized worker thread).
+
+Without the observer the scenario is *static-topology* (every node only
+ever sends to its fixed successor) and *inbox-commutative* (the step
+reduces over received tokens with max/sum), so it declares
+``static_dst``/``commutative_inbox`` and runs on the sort/scatter-free
+edge engine (interp/jax_engine/edge_engine.py). With the observer the
+hub node has in-degree N, so it stays on the general engine.
 """
 
 from __future__ import annotations
 
-from dataclasses import dataclass
 from typing import Tuple
 
 from ..utils import jaxconfig  # noqa: F401
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..core.scenario import NEVER, Inbox, Outbox, Scenario
 from ..core.time import Microsecond, ms, sec
@@ -56,17 +63,12 @@ def token_ring(n_ring: int, *,
         raise ValueError(f"n_tokens={n_tokens} exceeds n_ring={n_ring}")
     n_nodes = n_ring + (1 if with_observer else 0)
     obs_id = n_ring
-    K = mailbox_cap
 
     def step(state, inbox: Inbox, now, i, key):
-        cnt, val, send_at, prev, errs = (
-            state["cnt"], state["val"], state["send_at"],
-            state["prev"], state["errs"])
+        cnt, val, send_at = state["cnt"], state["val"], state["send_at"]
         kind = inbox.payload[:, 1]
         vin = inbox.payload[:, 0]
         tok_in = inbox.valid & (kind == TOKEN)
-        note_in = inbox.valid & (kind == NOTE)
-        is_obs = jnp.asarray(with_observer) & (i == obs_id)
 
         # --- ring-node half (Main.hs:137-154) ---
         got = tok_in.any()
@@ -86,6 +88,20 @@ def token_ring(n_ring: int, *,
                            jnp.int64(NEVER)),
             jnp.where(alive, send_at1, jnp.int64(NEVER)))
 
+        if not with_observer:
+            # lean static-topology form: one outbox slot, no observer
+            # bookkeeping — the dense-ring regime of the bench
+            out = Outbox(valid=due[None], dst=succ[None],
+                         payload=jnp.stack([val1 + 1,
+                                            jnp.int32(TOKEN)])[None])
+            new_state = {"cnt": cnt2, "val": val1, "send_at": send_at2}
+            return new_state, out, send_at2
+
+        prev, errs = state["prev"], state["errs"]
+        note_in = inbox.valid & (kind == NOTE)
+        is_obs = i == obs_id
+        W = inbox.valid.shape[0]  # inbox width is engine-dependent
+
         # --- observer half (Main.hs:197-208): monotone check in
         # inbox order ---
         def obs_scan(carry, j):
@@ -97,11 +113,11 @@ def token_ring(n_ring: int, *,
             return (p, e), None
 
         (prev1, errs1), _ = jax.lax.scan(
-            obs_scan, (prev, errs), jnp.arange(K))
+            obs_scan, (prev, errs), jnp.arange(W))
 
         # --- outbox: slot 0 = token to successor, slot 1 = note ---
         send_tok = due & ~is_obs
-        send_note = got & ~is_obs & jnp.asarray(with_observer) & alive
+        send_note = got & ~is_obs & alive
         valid = jnp.stack([send_tok, send_note])
         dst = jnp.stack([succ, jnp.int32(obs_id)])
         payload = jnp.stack([
@@ -128,9 +144,10 @@ def token_ring(n_ring: int, *,
             "cnt": jnp.int32(1 if holds else 0),
             "val": jnp.int32(0),
             "send_at": jnp.int64(send_at),
-            "prev": jnp.int32(0),
-            "errs": jnp.int32(0),
         }
+        if with_observer:
+            state["prev"] = jnp.int32(0)
+            state["errs"] = jnp.int32(0)
         return state, send_at if holds else NEVER
 
     def init_batched(n: int):
@@ -142,10 +159,17 @@ def token_ring(n_ring: int, *,
             "cnt": holds.astype(jnp.int32),
             "val": jnp.zeros(n, jnp.int32),
             "send_at": send_at,
-            "prev": jnp.zeros(n, jnp.int32),
-            "errs": jnp.zeros(n, jnp.int32),
         }
+        if with_observer:
+            states["prev"] = jnp.zeros(n, jnp.int32)
+            states["errs"] = jnp.zeros(n, jnp.int32)
         return states, send_at
+
+    if with_observer:
+        static_dst = None
+    else:
+        static_dst = ((np.arange(n_ring, dtype=np.int32) + 1)
+                      % n_ring).reshape(n_ring, 1)
 
     return Scenario(
         name=f"token-ring-{n_ring}",
@@ -154,8 +178,10 @@ def token_ring(n_ring: int, *,
         init=init,
         init_batched=init_batched,
         payload_width=2,
-        max_out=2,
-        mailbox_cap=K,
+        max_out=2 if with_observer else 1,
+        mailbox_cap=mailbox_cap,
+        static_dst=static_dst,
+        commutative_inbox=not with_observer,
         meta={"n_ring": n_ring, "obs_id": obs_id if with_observer else None,
               "end_us": end_us},
     )
