@@ -1,12 +1,14 @@
 """Per-piece timing of the engine superstep on the current backend.
 
-Times each building block of `JaxEngine._superstep` in isolation at the
-bench shapes, then the full superstep, to find where the per-superstep
-wall time goes. Run on TPU (default platform) or CPU (JAX_PLATFORMS=cpu).
+Times the building blocks of the *round-1* `JaxEngine._superstep`
+design in isolation at the bench shapes (pieces 3-6 measure the old
+int64-lexsort/scatter path on purpose — they are the evidence behind
+profiling/superstep_breakdown.md), then the full current superstep.
+Run on TPU (default platform) or CPU (JAX_PLATFORMS=cpu).
 
-Writes one JSON object per line to stdout; commit the result as
-profiling/superstep_breakdown.json (VERDICT round-1 item: "nobody has
-looked at where the time goes").
+Caveat from the breakdown doc: isolated per-dispatch numbers through
+the axon tunnel are unreliable; trust only the in-scan FULL-superstep
+figures at the bottom.
 """
 
 import json
@@ -118,7 +120,7 @@ def main():
     st = jax.block_until_ready(engine.init_state())
     st = jax.block_until_ready(engine.run_quiet(2, st))  # mid-flight state
 
-    step = jax.jit(lambda s: engine._superstep(s)[0])
+    step = jax.jit(lambda s: engine._superstep(s, False)[0])
     out = jax.block_until_ready(step(st))
     t0 = time.perf_counter()
     cur = st

@@ -13,19 +13,22 @@ re-designed for the TPU's execution model:
 - virtual time is driven by ``lax.scan`` (traced once, compiled once;
   no data-dependent Python control flow);
 - message delivery is a static-shape scatter with deterministic
-  sender-major ranking (and, in the sharded engine, an ``all_to_all``
-  over the TPU mesh — see sharded.py).
+  sender-major ranking (and, in the sharded engine, collectives over
+  the TPU mesh — see sharded.py; static topologies skip the scatter
+  entirely — see edge_engine.py).
 
 All supersteps execute the *fire-all-at-min* semantics of
 core/scenario.py, and the emitted trace must equal the host oracle's
 bit-for-bit (tests/test_parity.py). Everything observable is integer;
 time is int64 µs.
 
-Design notes for the MXU/VPU: the engine's own bookkeeping is
-elementwise/VPU work by nature (sorts, min-reductions, scatters over
-[N, K] int arrays); the MXU earns its keep inside user step functions
-(e.g. model-driven scenarios) which are free to use bf16 matmuls — the
-engine keeps them fused into the same scanned XLA computation.
+TPU cost notes (profiling/superstep_breakdown.md): int64 scatters are
+pathological and random scatters are the dominant real cost, so
+mailbox deliver-times are stored as **int32 relative** to the rebased
+epoch (``EngineState.time``), inbox ordering and mailbox compaction are
+single variadic ``lax.sort`` calls instead of lexsort+gather chains,
+and trace digests exist only in the traced driver (``run``) — the
+``run_quiet`` benchmark path compiles them out.
 """
 
 from __future__ import annotations
@@ -47,21 +50,29 @@ from ...trace.hashing import FIRED, RECV, SENT, mix32_jnp
 
 __all__ = ["JaxEngine", "EngineState"]
 
+_I32MAX = np.int32(2**31 - 1)
+
 
 class EngineState(NamedTuple):
     """The complete simulation state — one pytree, trivially
-    checkpointable (SURVEY.md §5.4) and shardable over a mesh."""
+    checkpointable (SURVEY.md §5.4) and shardable over a mesh.
+
+    Mailbox deliver-times are int32 µs relative to ``time`` (the epoch
+    is rebased every superstep); delays ≥ 2^31 µs are clamped and
+    counted in ``bad_delay``.
+    """
     states: Any        # scenario pytree, leading dim N
     wake: jax.Array    # int64[N]
-    mb_time: jax.Array     # int64[N, K]
+    mb_rel: jax.Array      # int32[N, K] — deliver time minus `time`
     mb_src: jax.Array      # int32[N, K]
     mb_payload: jax.Array  # int32[N, K, P]
     mb_valid: jax.Array    # bool[N, K]
     overflow: jax.Array    # int32[] — total overflowed messages
     bad_dst: jax.Array     # int32[] — total messages to invalid destinations
+    bad_delay: jax.Array   # int32[] — delays >= 2^31 µs, clamped
     delivered: jax.Array   # int64[] — total delivered messages
     steps: jax.Array       # int64[] — supersteps executed
-    time: jax.Array        # int64[] — current virtual time
+    time: jax.Array        # int64[] — current virtual time == mailbox epoch
 
 
 class _StepOut(NamedTuple):
@@ -90,10 +101,14 @@ def _thi(t: jax.Array) -> jax.Array:
 
 
 class JaxEngine:
-    """Single-chip batched engine. ``run(max_steps)`` executes up to
-    ``max_steps`` supersteps under one ``lax.scan`` and returns the
-    final :class:`EngineState` plus the trace; ``run_quiet`` drops the
-    per-step trace (pure ``lax.while_loop``) for benchmarking."""
+    """Single-chip batched engine for arbitrary (dynamic-destination)
+    scenarios. ``run(max_steps)`` executes up to ``max_steps``
+    supersteps under one ``lax.scan`` and returns the final
+    :class:`EngineState` plus the trace; ``run_quiet`` drops the trace
+    (pure ``lax.while_loop``, digests not compiled in) for
+    benchmarking. Static-topology scenarios should prefer
+    :class:`~timewarp_tpu.interp.jax_engine.edge_engine.EdgeEngine`.
+    """
 
     def __init__(self, scenario: Scenario, link: LinkModel, *,
                  seed: int = 0) -> None:
@@ -118,12 +133,13 @@ class JaxEngine:
         return EngineState(
             states=states,
             wake=wake,
-            mb_time=jnp.full((n, K), NEVER, jnp.int64),
+            mb_rel=jnp.full((n, K), _I32MAX, jnp.int32),
             mb_src=jnp.zeros((n, K), jnp.int32),
             mb_payload=jnp.zeros((n, K, P), jnp.int32),
             mb_valid=jnp.zeros((n, K), bool),
             overflow=jnp.int32(0),
             bad_dst=jnp.int32(0),
+            bad_delay=jnp.int32(0),
             delivered=jnp.int64(0),
             steps=jnp.int64(0),
             time=jnp.int64(0),
@@ -131,38 +147,48 @@ class JaxEngine:
 
     # -- one superstep ---------------------------------------------------
 
-    def _superstep(self, st: EngineState) -> Tuple[EngineState, _StepOut]:
+    def _superstep(self, st: EngineState, with_trace: bool
+                   ) -> Tuple[EngineState, Optional[_StepOut]]:
         sc = self.scenario
         n, K, M, P = sc.n_nodes, sc.mailbox_cap, sc.max_out, sc.payload_width
         node_ids = jnp.arange(n, dtype=jnp.int32)
+        base = st.time
 
         # 1. global next event time (the batched "pop min", TimedT.hs:241-245)
-        mb_eff = jnp.where(st.mb_valid, st.mb_time, NEVER)
-        node_next = jnp.minimum(st.wake, mb_eff.min(axis=1))
+        mb_eff = jnp.where(st.mb_valid, st.mb_rel, _I32MAX)
+        nnr = mb_eff.min(axis=1)
+        node_next = jnp.minimum(
+            st.wake,
+            jnp.where(nnr == _I32MAX, jnp.int64(NEVER),
+                      base + nnr.astype(jnp.int64)))
         t = node_next.min()
         live = t < NEVER
         fire = (node_next == t) & live
+        shift32 = jnp.minimum(t - base,
+                              jnp.int64(_I32MAX - 1)).astype(jnp.int32)
 
         # 2. deliverable messages, per firing node
-        deliver = st.mb_valid & (st.mb_time <= t) & fire[:, None]
+        deliver = st.mb_valid & (st.mb_rel <= shift32) & fire[:, None]
 
         # 3. inbox: delivered slots first, ordered by (time, arrival slot)
-        #    (determinism contract #2)
+        #    (determinism contract #2) — one variadic sort per row
         slots = jnp.broadcast_to(jnp.arange(K, dtype=jnp.int32), (n, K))
-        perm = jnp.lexsort((slots, st.mb_time, ~deliver), axis=-1)
-        take = partial(jnp.take_along_axis, axis=1)
-        ib_valid = take(deliver, perm)
+        rel_key = jnp.where(deliver, st.mb_rel, _I32MAX)
+        ops = jax.lax.sort(
+            (~deliver, rel_key, slots, st.mb_src) + tuple(
+                st.mb_payload[:, :, p] for p in range(P)),
+            dimension=1, num_keys=3)
+        ib_valid, ib_rel, ib_src = ~ops[0], ops[1], ops[3]
+        ib_pay = jnp.stack(ops[4:4 + P], axis=2)
         # pad invalid slots exactly like the oracle (src=0, time=NEVER,
         # payload=0) so an unmasked read in a user step function cannot
         # diverge between interpreters
         inbox = Inbox(
             valid=ib_valid,
-            src=jnp.where(ib_valid, take(st.mb_src, perm), 0),
-            time=jnp.where(ib_valid, take(st.mb_time, perm), NEVER),
-            payload=jnp.where(
-                ib_valid[:, :, None],
-                jnp.take_along_axis(st.mb_payload, perm[:, :, None], axis=1),
-                0),
+            src=jnp.where(ib_valid, ib_src, 0),
+            time=jnp.where(ib_valid, base + ib_rel.astype(jnp.int64),
+                           jnp.int64(NEVER)),
+            payload=jnp.where(ib_valid[:, :, None], ib_pay, 0),
         )
 
         # 4. fire every node simultaneously; mask non-fired results.
@@ -181,14 +207,17 @@ class JaxEngine:
         wake = jnp.where(fire, new_wake, st.wake)
         out_valid = out.valid & fire[:, None]
 
-        # 5. compact mailboxes: drop delivered, keep arrival order
+        # 5. compact mailboxes: drop delivered, keep arrival order,
+        #    rebase surviving deliver-times to the new epoch t
         keep = st.mb_valid & ~deliver
-        perm2 = jnp.lexsort((slots, ~keep), axis=-1)
-        mb_time = take(st.mb_time, perm2)
-        mb_src = take(st.mb_src, perm2)
-        mb_payload = jnp.take_along_axis(st.mb_payload, perm2[:, :, None],
-                                         axis=1)
-        mb_valid = take(keep, perm2)
+        ops2 = jax.lax.sort(
+            (~keep, slots, st.mb_rel, st.mb_src) + tuple(
+                st.mb_payload[:, :, p] for p in range(P)),
+            dimension=1, num_keys=2)
+        mb_valid = ~ops2[0]
+        mb_rel = jnp.where(mb_valid, ops2[2] - shift32, _I32MAX)
+        mb_src = ops2[3]
+        mb_payload = jnp.stack(ops2[4:4 + P], axis=2)
         counts = mb_valid.sum(axis=1, dtype=jnp.int32)
 
         # 6. route outboxes in sender-major order (contract #3)
@@ -206,7 +235,10 @@ class JaxEngine:
         # contract #6 corollary: a scenario emitting an out-of-range
         # destination is a bug — surfaced, never silently dropped
         bad_dst_step = jnp.sum(v_f & ~dst_ok, dtype=jnp.int32)
-        dtime = t + jnp.maximum(delay.astype(jnp.int64), 1)  # contract #4
+        drel64 = jnp.maximum(delay, jnp.int64(1))  # contract #4
+        bad_delay_step = jnp.sum(
+            ok & (drel64 > jnp.int64(_I32MAX - 1)), dtype=jnp.int32)
+        drel = jnp.minimum(drel64, jnp.int64(_I32MAX - 1)).astype(jnp.int32)
 
         # 7. insert: stable sort by destination; rank within destination
         #    = sender-major arrival order; bounded by mailbox capacity
@@ -215,43 +247,51 @@ class JaxEngine:
         sd = sort_dst[perm3]
         rank = jnp.arange(S, dtype=jnp.int32) - jnp.searchsorted(
             sd, sd, side="left").astype(jnp.int32)
-        base = counts[jnp.clip(sd, 0, n - 1)]
-        pos = base + rank
+        base_cnt = counts[jnp.clip(sd, 0, n - 1)]
+        pos = base_cnt + rank
         ok_s = ok[perm3]
         fits = ok_s & (pos < K)
         row = jnp.where(fits, sd, n)  # out-of-range row -> dropped scatter
         col = jnp.clip(pos, 0, K - 1)
-        mb_time = mb_time.at[row, col].set(dtime[perm3], mode="drop")
+        mb_rel = mb_rel.at[row, col].set(drel[perm3], mode="drop")
         mb_src = mb_src.at[row, col].set(src_f[perm3], mode="drop")
         mb_payload = mb_payload.at[row, col].set(pay_f[perm3], mode="drop")
         mb_valid = mb_valid.at[row, col].set(fits, mode="drop")
         overflow_step = jnp.sum(ok_s & (pos >= K), dtype=jnp.int32)
 
-        # 8. trace digests (order-independent — trace/hashing.py)
-        fired_hash = _u32sum(jnp.where(fire, mix32_jnp(FIRED, node_ids), 0))
-        recv_mix = mix32_jnp(
-            RECV, jnp.broadcast_to(node_ids[:, None], (n, K)),
-            inbox.src, _tlo(inbox.time), _thi(inbox.time),
-            inbox.payload[:, :, 0])
-        recv_hash = _u32sum(jnp.where(inbox.valid, recv_mix, 0))
-        sent_mix = mix32_jnp(SENT, src_f, dst_f, _tlo(dtime), _thi(dtime),
-                             pay_f[:, 0])
-        sent_hash = _u32sum(jnp.where(ok, sent_mix, 0))
-        recv_count = jnp.sum(inbox.valid, dtype=jnp.int32)
-        sent_count = jnp.sum(ok, dtype=jnp.int32)
-
+        recv_count = jnp.sum(deliver, dtype=jnp.int32)
         new_st = EngineState(
             states=states, wake=wake,
-            mb_time=mb_time, mb_src=mb_src, mb_payload=mb_payload,
+            mb_rel=mb_rel, mb_src=mb_src, mb_payload=mb_payload,
             mb_valid=mb_valid,
             overflow=st.overflow + overflow_step,
             bad_dst=st.bad_dst + bad_dst_step,
+            bad_delay=st.bad_delay + bad_delay_step,
             delivered=st.delivered + recv_count.astype(jnp.int64),
             steps=st.steps + 1,
             time=t,
         )
         # freeze everything once quiesced
         final = jax.tree.map(lambda a, b: jnp.where(live, b, a), st, new_st)
+        if not with_trace:
+            return final, None
+
+        # 8. trace digests (order-independent — trace/hashing.py);
+        # computed from the pre-sort deliver mask: the uint32 sum is
+        # commutative, so this equals the sorted-inbox digest
+        fired_hash = _u32sum(jnp.where(fire, mix32_jnp(FIRED, node_ids), 0))
+        d_abs = base + jnp.where(deliver, st.mb_rel, 0).astype(jnp.int64)
+        recv_mix = mix32_jnp(
+            RECV, jnp.broadcast_to(node_ids[:, None], (n, K)),
+            st.mb_src, _tlo(d_abs), _thi(d_abs),
+            st.mb_payload[:, :, 0])
+        recv_hash = _u32sum(jnp.where(deliver, recv_mix, 0))
+        dt_abs = t + drel64
+        sent_mix = mix32_jnp(SENT, src_f, dst_f, _tlo(dt_abs), _thi(dt_abs),
+                             pay_f[:, 0])
+        sent_hash = _u32sum(jnp.where(ok, sent_mix, 0))
+        sent_count = jnp.sum(ok, dtype=jnp.int32)
+
         yrow = _StepOut(
             valid=live, t=t,
             fired_count=jnp.sum(fire, dtype=jnp.int32),
@@ -270,7 +310,7 @@ class JaxEngine:
     @partial(jax.jit, static_argnums=(0, 2))
     def _run_scan(self, st: EngineState, max_steps: int):
         def body(carry, _):
-            return self._superstep(carry)
+            return self._superstep(carry, True)
         return jax.lax.scan(body, st, None, length=max_steps)
 
     def run(self, max_steps: int,
@@ -297,19 +337,21 @@ class JaxEngine:
         max_steps = jnp.asarray(max_steps, jnp.int64)
 
         def cond(carry):
-            mb_eff = jnp.where(carry.mb_valid, carry.mb_time, NEVER)
-            nxt = jnp.minimum(carry.wake.min(), mb_eff.min())
+            mmin = jnp.where(carry.mb_valid, carry.mb_rel, _I32MAX).min()
+            nxt = jnp.minimum(
+                carry.wake.min(),
+                jnp.where(mmin == _I32MAX, jnp.int64(NEVER),
+                          carry.time + mmin.astype(jnp.int64)))
             return (nxt < NEVER) & (carry.steps - start_steps < max_steps)
 
         def body(carry):
-            nxt, _ = self._superstep(carry)
-            return nxt
+            return self._superstep(carry, False)[0]
 
         return jax.lax.while_loop(cond, body, st)
 
     def run_quiet(self, max_steps: int,
                   state: Optional[EngineState] = None) -> EngineState:
         """Traceless driver for benchmarking: one ``while_loop``, no
-        per-step host materialization."""
+        per-step host materialization and no digest work compiled in."""
         st = state if state is not None else self.init_state()
         return self._run_while(st, max_steps)
