@@ -1,0 +1,71 @@
+"""Shared machinery of the batched engines: trace-row container,
+digest helpers, int32 sentinels, and the device-communication
+abstraction that lets one superstep implementation run single-chip or
+sharded over a mesh (sharded.py)."""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["LocalComm", "StepOut", "I32MAX", "u32sum", "tlo", "thi"]
+
+I32MAX = np.int32(2**31 - 1)
+
+
+class StepOut(NamedTuple):
+    """Per-superstep trace row (valid=False once the scenario quiesced)."""
+    valid: jax.Array
+    t: jax.Array
+    fired_count: jax.Array
+    fired_hash: jax.Array
+    recv_count: jax.Array
+    recv_hash: jax.Array
+    sent_count: jax.Array
+    sent_hash: jax.Array
+    overflow: jax.Array
+
+
+def u32sum(x: jax.Array) -> jax.Array:
+    return jnp.sum(x.astype(jnp.uint32), dtype=jnp.uint32)
+
+
+def tlo(t: jax.Array) -> jax.Array:
+    return (t & jnp.int64(0xFFFFFFFF)).astype(jnp.uint32)
+
+
+def thi(t: jax.Array) -> jax.Array:
+    return ((t >> jnp.int64(32)) & jnp.int64(0xFFFFFFFF)).astype(jnp.uint32)
+
+
+class LocalComm:
+    """Single-device communication: every "collective" is local. The
+    sharded engines (sharded.py) substitute mesh collectives (pmin /
+    psum / ppermute / all_to_all) behind the same operations, so one
+    superstep implementation serves both."""
+
+    def __init__(self, n_global: int) -> None:
+        self.n_global = n_global
+        self.n_local = n_global
+        self.n_shards = 1
+
+    def node_ids(self) -> jax.Array:
+        """Global ids of the nodes this device owns."""
+        return jnp.arange(self.n_local, dtype=jnp.int32)
+
+    def all_min(self, x: jax.Array) -> jax.Array:
+        return x
+
+    def all_sum(self, x: jax.Array) -> jax.Array:
+        return x
+
+    def roll(self, x: jax.Array, s: int) -> jax.Array:
+        """Global roll by ``s`` along the (last) node axis."""
+        return jnp.roll(x, s, axis=-1)
+
+    def local_rows(self, table: np.ndarray) -> jax.Array:
+        """This device's column block of a host table [..., N]."""
+        return jnp.asarray(table)

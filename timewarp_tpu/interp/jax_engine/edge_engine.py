@@ -57,11 +57,11 @@ from ...core.scenario import NEVER, Inbox, Outbox, Scenario
 from ...net.delays import LinkModel
 from ...trace.events import SuperstepTrace
 from ...trace.hashing import FIRED, RECV, SENT, mix32_jnp
-from .engine import _StepOut, _thi, _tlo, _u32sum
+from .common import I32MAX as _I32MAX
+from .common import LocalComm, StepOut as _StepOut
+from .common import thi as _thi, tlo as _tlo, u32sum as _u32sum
 
 __all__ = ["EdgeEngine", "EdgeState", "EdgeTopology"]
-
-_I32MAX = np.int32(2**31 - 1)
 
 
 class EdgeTopology(NamedTuple):
@@ -157,6 +157,7 @@ class EdgeEngine:
         self.cap = cap
         self.topo = EdgeTopology.build(scenario.static_dst,
                                        scenario.n_nodes)
+        self.comm = LocalComm(scenario.n_nodes)
 
     # -- initial state ---------------------------------------------------
 
@@ -192,10 +193,12 @@ class EdgeEngine:
 
     def _superstep(self, st: EdgeState, with_trace: bool
                    ) -> Tuple[EdgeState, Optional[_StepOut]]:
-        sc, topo = self.scenario, self.topo
-        n, E, C, P = sc.n_nodes, topo.n_edges, self.cap, sc.payload_width
+        sc, topo, comm = self.scenario, self.topo, self.comm
+        E, C, P = topo.n_edges, self.cap, sc.payload_width
+        n = comm.n_local            # array width on this device
+        n_glob = comm.n_global
         W = E * C
-        node_ids = jnp.arange(n, dtype=jnp.int32)
+        node_ids = comm.node_ids()  # global identities, int32[n]
         base = st.time
 
         # 1. global next event time (the batched "pop min")
@@ -205,7 +208,7 @@ class EdgeEngine:
             st.wake,
             jnp.where(nnr == _I32MAX, jnp.int64(NEVER),
                       base + nnr.astype(jnp.int64)))
-        t = node_next.min()
+        t = comm.all_min(node_next.min())
         live = t < NEVER
         fire = (node_next == t) & live
 
@@ -219,8 +222,15 @@ class EdgeEngine:
         iv = deliver.reshape(W, n)
         rel = jnp.where(iv, st.q_rel.reshape(W, n), _I32MAX)
         istep = st.q_step.reshape(W, n)
+        # per-edge sender ids: computable elementwise for shift edges
+        # (works sharded); table lookup otherwise (local only)
+        src_rows = jnp.stack([
+            (node_ids - jnp.int32(topo.shift[e][0])) % jnp.int32(n_glob)
+            if topo.shift[e] is not None
+            else comm.local_rows(topo.in_src[e])
+            for e in range(E)], axis=0)                      # int32[E, n]
         isrc = jnp.broadcast_to(
-            jnp.asarray(topo.in_src)[:, None, :], (E, C, n)).reshape(W, n)
+            src_rows[:, None, :], (E, C, n)).reshape(W, n)
         ipay = st.q_pay.reshape(W, P, n)
         if not sc.commutative_inbox:
             # contract #2 order: (deliver_time, insert_step, sender-major
@@ -264,7 +274,7 @@ class EdgeEngine:
         out_pay = out.payload                                # [M, P, N]
         # never-silent contract: a valid send on a slot whose static_dst
         # is -1 has nowhere to go — counted (≙ JaxEngine's bad_dst)
-        declared = jnp.asarray(
+        declared = comm.local_rows(
             (np.asarray(sc.static_dst, np.int32) >= 0).T)    # [M, N]
         unrouted_step = jnp.sum(out_valid & ~declared, dtype=jnp.int32)
 
@@ -286,16 +296,17 @@ class EdgeEngine:
             sh = topo.shift[e]
             if sh is not None:
                 s, slot = sh
-                arr_v = jnp.roll(out_valid[slot], s)
-                arr_p = jnp.roll(out_pay[slot], s, axis=-1)  # [P, N]
+                arr_v = comm.roll(out_valid[slot], s)
+                arr_p = comm.roll(out_pay[slot], s)          # [P, N]
+                slot_e = jnp.int32(slot)
             else:
                 flat_idx = jnp.asarray(topo.in_flat[e])
                 arr_v = out_valid.reshape(-1)[flat_idx] \
                     & jnp.asarray(topo.in_valid[e])
                 arr_p = out_pay.transpose(1, 0, 2).reshape(P, -1)[
                     :, flat_idx]
-            src_e = jnp.asarray(topo.in_src[e])
-            slot_e = jnp.asarray(topo.in_slot[e])
+                slot_e = comm.local_rows(topo.in_slot[e])
+            src_e = src_rows[e]
             mb = msg_bits(self.s0, self.s1, src_e, node_ids, t, slot_e) \
                 if self.link.needs_key else None
             delay, drop = self.link.sample(src_e, node_ids, t, mb)
@@ -329,13 +340,14 @@ class EdgeEngine:
             overflow_step = overflow_step + jnp.sum(
                 ok & (ff == C), dtype=jnp.int32)
 
-        recv_count = jnp.sum(deliver, dtype=jnp.int32)
+        recv_count = comm.all_sum(jnp.sum(deliver, dtype=jnp.int32))
+        overflow_step = comm.all_sum(overflow_step)
         new_st = EdgeState(
             states=states, wake=wake,
             q_rel=q_rel, q_step=q_step, q_pay=q_pay, q_valid=q_valid,
             overflow=st.overflow + overflow_step,
-            unrouted=st.unrouted + unrouted_step,
-            bad_delay=st.bad_delay + bad_delay_total,
+            unrouted=st.unrouted + comm.all_sum(unrouted_step),
+            bad_delay=st.bad_delay + comm.all_sum(bad_delay_total),
             delivered=st.delivered + recv_count.astype(jnp.int64),
             steps=st.steps + 1,
             time=t,
@@ -346,21 +358,23 @@ class EdgeEngine:
 
         # 8. trace digests (order-independent; computed pre-sort from the
         # deliver mask — identical to the sorted-inbox digest by
-        # commutativity of the uint32 sum)
-        fired_hash = _u32sum(jnp.where(fire, mix32_jnp(FIRED, node_ids), 0))
+        # commutativity of the (wrapping) uint32 sum, which also makes
+        # the cross-device psum exact)
+        fired_hash = comm.all_sum(
+            _u32sum(jnp.where(fire, mix32_jnp(FIRED, node_ids), 0)))
         d_abs = base + jnp.where(deliver, st.q_rel, 0).astype(jnp.int64)
         rmix = mix32_jnp(
             RECV, jnp.broadcast_to(node_ids, (E, C, n)),
-            jnp.broadcast_to(jnp.asarray(topo.in_src)[:, None, :],
-                             (E, C, n)),
+            jnp.broadcast_to(src_rows[:, None, :], (E, C, n)),
             _tlo(d_abs), _thi(d_abs), st.q_pay[:, :, 0, :])
-        recv_hash = _u32sum(jnp.where(deliver, rmix, 0))
+        recv_hash = comm.all_sum(_u32sum(jnp.where(deliver, rmix, 0)))
         yrow = _StepOut(
             valid=live, t=t,
-            fired_count=jnp.sum(fire, dtype=jnp.int32),
+            fired_count=comm.all_sum(jnp.sum(fire, dtype=jnp.int32)),
             fired_hash=fired_hash,
             recv_count=recv_count, recv_hash=recv_hash,
-            sent_count=sent_count, sent_hash=sent_hash,
+            sent_count=comm.all_sum(sent_count),
+            sent_hash=comm.all_sum(sent_hash),
             overflow=overflow_step,
         )
         yrow = jax.tree.map(
@@ -397,10 +411,10 @@ class EdgeEngine:
         def cond(carry):
             qmin = jnp.where(carry.q_valid, carry.q_rel, _I32MAX).min()
             has_q = qmin < _I32MAX
-            nxt = jnp.minimum(
+            nxt = self.comm.all_min(jnp.minimum(
                 carry.wake.min(),
                 jnp.where(has_q, carry.time + qmin.astype(jnp.int64),
-                          jnp.int64(NEVER)))
+                          jnp.int64(NEVER))))
             return (nxt < NEVER) & (carry.steps - start_steps < max_steps)
 
         def body(carry):

@@ -47,10 +47,11 @@ from ...core.scenario import NEVER, Inbox, Scenario
 from ...net.delays import LinkModel
 from ...trace.events import SuperstepTrace
 from ...trace.hashing import FIRED, RECV, SENT, mix32_jnp
+from .common import I32MAX as _I32MAX
+from .common import LocalComm, StepOut as _StepOut
+from .common import thi as _thi, tlo as _tlo, u32sum as _u32sum
 
 __all__ = ["JaxEngine", "EngineState"]
-
-_I32MAX = np.int32(2**31 - 1)
 
 
 class EngineState(NamedTuple):
@@ -73,31 +74,6 @@ class EngineState(NamedTuple):
     delivered: jax.Array   # int64[] — total delivered messages
     steps: jax.Array       # int64[] — supersteps executed
     time: jax.Array        # int64[] — current virtual time == mailbox epoch
-
-
-class _StepOut(NamedTuple):
-    """Per-superstep trace row (valid=False once the scenario quiesced)."""
-    valid: jax.Array
-    t: jax.Array
-    fired_count: jax.Array
-    fired_hash: jax.Array
-    recv_count: jax.Array
-    recv_hash: jax.Array
-    sent_count: jax.Array
-    sent_hash: jax.Array
-    overflow: jax.Array
-
-
-def _u32sum(x: jax.Array) -> jax.Array:
-    return jnp.sum(x.astype(jnp.uint32), dtype=jnp.uint32)
-
-
-def _tlo(t: jax.Array) -> jax.Array:
-    return (t & jnp.int64(0xFFFFFFFF)).astype(jnp.uint32)
-
-
-def _thi(t: jax.Array) -> jax.Array:
-    return ((t >> jnp.int64(32)) & jnp.int64(0xFFFFFFFF)).astype(jnp.uint32)
 
 
 class JaxEngine:

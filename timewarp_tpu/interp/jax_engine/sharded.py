@@ -1,0 +1,212 @@
+"""Sharded engines: the same superstep semantics over a device mesh.
+
+SURVEY.md §2.5/§5.8: simulated-node message passing maps onto XLA
+collectives over the mesh's ICI — ``ppermute`` for fixed shift
+topologies (the token ring's neighbor exchange), ``all_to_all`` for
+dynamic destinations — instead of the reference's TCP sockets
+(`/root/reference/src/Control/TimeWarp/Rpc/Transfer.hs:473,577`).
+
+Two engines, one per delivery pattern:
+
+- :class:`ShardedEdgeEngine` — the edge engine (edge_engine.py) run
+  under ``shard_map`` with the node axis sharded. All communication
+  goes through :class:`MeshComm`: the global clock min is a ``pmin``,
+  counters and trace digests are ``psum`` (the digests are *wrapping
+  uint32 sums*, so the cross-device reduction is exact, not
+  approximate), and the ring delivery roll becomes a boundary-slice
+  ``ppermute`` — one neighbor hop over ICI per superstep, never an
+  all-gather. Requires a pure-shift topology (every edge a constant
+  ring offset); anything else needs cross-shard gathers and belongs to
+  the all_to_all engine.
+- :class:`ShardedEngine` — the general engine (engine.py) with its
+  routing stage replaced by destination-shard bucketing + one
+  ``lax.all_to_all`` exchange per superstep, with per-(src-shard,
+  dst-shard) bucket capacity; bucket overflow is counted, never
+  silent.
+
+The acceptance law is unchanged: an 8-device run must reproduce the
+1-device trace **bit-for-bit** (tests/test_sharded.py runs both
+engines on a virtual 8-device CPU mesh against the host oracle).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Tuple
+
+from ...utils import jaxconfig  # noqa: F401
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ...core.scenario import Scenario
+from ...net.delays import LinkModel
+from .common import LocalComm
+from .edge_engine import EdgeEngine, EdgeState
+
+__all__ = ["MeshComm", "ShardedEdgeEngine", "make_mesh"]
+
+
+def make_mesh(n_devices: Optional[int] = None,
+              axis: str = "nodes") -> Mesh:
+    """A 1-D mesh over the first ``n_devices`` available devices."""
+    devs = jax.devices()
+    if n_devices is None:
+        n_devices = len(devs)
+    return Mesh(np.asarray(devs[:n_devices]), (axis,))
+
+
+class MeshComm(LocalComm):
+    """Mesh collectives behind the LocalComm interface; valid only
+    inside a ``shard_map`` body with ``axis`` bound."""
+
+    def __init__(self, axis: str, n_global: int, n_shards: int) -> None:
+        if n_global % n_shards:
+            raise ValueError(
+                f"n_nodes {n_global} not divisible by {n_shards} shards")
+        self.axis = axis
+        self.n_global = n_global
+        self.n_shards = n_shards
+        self.n_local = n_global // n_shards
+
+    def node_ids(self) -> jax.Array:
+        off = jax.lax.axis_index(self.axis).astype(jnp.int32) \
+            * jnp.int32(self.n_local)
+        return off + jnp.arange(self.n_local, dtype=jnp.int32)
+
+    def all_min(self, x: jax.Array) -> jax.Array:
+        return jax.lax.pmin(x, self.axis)
+
+    def all_sum(self, x: jax.Array) -> jax.Array:
+        return jax.lax.psum(x, self.axis)
+
+    def roll(self, x: jax.Array, s: int) -> jax.Array:
+        """Global roll by ``s`` along the last (node) axis: local roll +
+        boundary-slice ``ppermute`` to the next shard (and a whole-shard
+        ``ppermute`` when ``s`` spans shards). One ICI neighbor hop for
+        the ring's s=1."""
+        s = s % self.n_global
+        if s == 0:
+            return x
+        D, nl = self.n_shards, self.n_local
+        whole, rem = divmod(s, nl)
+        if whole:
+            perm = [(i, (i + whole) % D) for i in range(D)]
+            x = jax.lax.ppermute(x, self.axis, perm)
+        if rem:
+            tail = x[..., nl - rem:]
+            perm = [(i, (i + 1) % D) for i in range(D)]
+            recv = jax.lax.ppermute(tail, self.axis, perm)
+            x = jnp.concatenate([recv, x[..., :nl - rem]], axis=-1)
+        return x
+
+    def local_rows(self, table: np.ndarray) -> jax.Array:
+        off = jax.lax.axis_index(self.axis).astype(jnp.int32) \
+            * jnp.int32(self.n_local)
+        return jax.lax.dynamic_slice_in_dim(
+            jnp.asarray(table), off, self.n_local, axis=-1)
+
+
+class ShardedEdgeEngine(EdgeEngine):
+    """Edge engine over a mesh: node axis sharded, ring delivery on
+    ``ppermute``. Same ``run`` / ``run_quiet`` API; states are placed
+    with ``NamedSharding`` so XLA keeps every per-node array resident
+    on its owning device across the whole ``while_loop``."""
+
+    def __init__(self, scenario: Scenario, link: LinkModel,
+                 mesh: Mesh, *, axis: str = "nodes", seed: int = 0,
+                 cap: int = 2) -> None:
+        super().__init__(scenario, link, seed=seed, cap=cap)
+        bad = [e for e, s in enumerate(self.topo.shift) if s is None]
+        if bad:
+            raise ValueError(
+                f"edges {bad} are not pure shifts; the sharded edge "
+                "engine delivers by ppermute only — use the all_to_all "
+                "ShardedEngine for irregular topologies")
+        self.mesh = mesh
+        self.axis = axis
+        D = mesh.shape[axis]
+        self.comm = MeshComm(axis, scenario.n_nodes, D)
+        for e, s in enumerate(self.topo.shift):
+            if s[0] % self.comm.n_local == 0 and s[0] != 0 \
+                    and D > 1 and (s[0] // self.comm.n_local) % D == 0:
+                raise ValueError(
+                    f"edge {e} shift {s[0]} is a multiple of the global "
+                    "size per mesh ring — degenerate sharding")
+
+    # -- sharding specs --------------------------------------------------
+
+    def _state_specs(self, st: EdgeState) -> EdgeState:
+        ax = self.axis
+
+        def leaf(x, last_axis: bool):
+            nd = getattr(x, "ndim", 0)
+            if nd == 0:
+                return P()
+            if last_axis:
+                return P(*([None] * (nd - 1) + [ax]))
+            return P(ax, *([None] * (nd - 1)))
+
+        return EdgeState(
+            states=jax.tree.map(lambda x: leaf(x, False), st.states),
+            wake=P(ax),
+            q_rel=leaf(st.q_rel, True),
+            q_step=leaf(st.q_step, True),
+            q_pay=leaf(st.q_pay, True),
+            q_valid=leaf(st.q_valid, True),
+            overflow=P(), unrouted=P(), bad_delay=P(),
+            delivered=P(), steps=P(), time=P(),
+        )
+
+    def init_state(self) -> EdgeState:
+        st = super().init_state()
+        specs = self._state_specs(st)
+        return jax.tree.map(
+            lambda x, s: jax.device_put(x, NamedSharding(self.mesh, s)),
+            st, specs)
+
+    # -- drivers ---------------------------------------------------------
+
+    @partial(jax.jit, static_argnums=(0, 2))
+    def _run_scan(self, st: EdgeState, max_steps: int):
+        specs = self._state_specs(st)
+
+        def body(s):
+            def step(carry, _):
+                return self._superstep(carry, True)
+            return jax.lax.scan(step, s, None, length=max_steps)
+
+        return jax.shard_map(
+            body, mesh=self.mesh, in_specs=(specs,),
+            out_specs=(specs, P()), check_vma=False)(st)
+
+    @partial(jax.jit, static_argnums=(0,))
+    def _run_while(self, st: EdgeState, max_steps) -> EdgeState:
+        specs = self._state_specs(st)
+        max_steps = jnp.asarray(max_steps, jnp.int64)
+        from ...core.scenario import NEVER
+        from .common import I32MAX
+
+        def body_fn(s, ms):
+            start_steps = s.steps
+
+            def cond(carry):
+                qmin = jnp.where(carry.q_valid, carry.q_rel, I32MAX).min()
+                has_q = qmin < I32MAX
+                nxt = self.comm.all_min(jnp.minimum(
+                    carry.wake.min(),
+                    jnp.where(has_q,
+                              carry.time + qmin.astype(jnp.int64),
+                              jnp.int64(NEVER))))
+                return (nxt < NEVER) & (carry.steps - start_steps < ms)
+
+            def body(carry):
+                return self._superstep(carry, False)[0]
+
+            return jax.lax.while_loop(cond, body, s)
+
+        return jax.shard_map(
+            body_fn, mesh=self.mesh, in_specs=(specs, P()),
+            out_specs=specs, check_vma=False)(st, max_steps)
