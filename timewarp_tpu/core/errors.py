@@ -28,6 +28,17 @@ class TimeoutExpired(TimedError):
     (≙ ``MTTimeoutError``, MonadTimed.hs:69-73; thrown at TimedT.hs:370-376)."""
 
 
+class DeadlockError(TimedError):
+    """Delivered by the pure emulator to every thread still ``Park``\\ ed
+    when the event queue drains: nothing can ever wake it again.
+
+    ≙ GHC's ``BlockedIndefinitelyOnMVar`` — the reference inherits that
+    detection from the RTS; the emulator must provide it explicitly or a
+    deadlocked scenario would be indistinguishable from quiescence.
+    Delivered *into* the thread (catchable; ``finally`` blocks run).
+    """
+
+
 class ThreadKilled(Exception):
     """Async exception delivered by ``kill_thread``
     (≙ ``AsyncException ThreadKilled``, MonadTimed.hs:204-206).

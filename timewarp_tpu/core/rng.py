@@ -20,6 +20,8 @@ from __future__ import annotations
 
 from typing import Tuple
 
+from ..utils import jaxconfig  # noqa: F401  (int64 time words need x64)
+
 import jax.numpy as jnp
 
 __all__ = [

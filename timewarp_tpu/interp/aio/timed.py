@@ -43,15 +43,13 @@ from typing import Any, Dict, List, Optional
 
 from ...core.effects import (AwaitIO, Fork, GetLogName, GetTime, MyTid, Park,
                              ProgramFn, SetLogName, ThrowTo, Unpark, Wait)
-from ...core.errors import ThreadKilled
 from ...core.time import Microsecond, resolve
+from ..common import NO_TOKEN as _NO_TOKEN
+from ..common import log_thread_death
 
 __all__ = ["RealTime", "AioThreadId", "run_real_time"]
 
 _log = logging.getLogger("timewarp.realtime")
-
-#: sentinel: no unpark token pending
-_NO_TOKEN = object()
 
 
 @dataclass(frozen=True)
@@ -150,20 +148,31 @@ class RealTime:
         except BaseException as e:  # noqa: BLE001 — interpreter boundary
             if is_main:
                 raise
-            # ≙ threadKilledNotifier (TimedT.hs:306-316)
-            level = logging.DEBUG if isinstance(e, ThreadKilled) \
-                else logging.WARNING
-            _log.log(level, "[%s] Thread killed by exception: %r",
-                     th.log_name, e)
+            log_thread_death(_log, th.log_name, e)
             return None
         finally:
             th.done.set()
             self._threads.pop(th.tid, None)
 
     async def _run_program(self, th: _Thread, program_fn: ProgramFn) -> Any:
+        # Pre-start throw_to parity with the emulator (des.py _step): an
+        # exception stored before the body first runs kills the thread
+        # without creating the frame — no user handler exists yet.
+        if th.pending_exc is not None:
+            raise self._pop_exc(th)
         gen = program_fn()
         if not hasattr(gen, "send"):
             return gen  # yield-free program: already ran at call time
+        try:
+            return await self._drive_gen(th, gen)
+        finally:
+            # Runs the program's finally blocks even when the *task* is
+            # cancelled out of a suspension point (e.g. scenario-exit
+            # survivor cleanup) — GeneratorExit at the yield, exactly
+            # like GHC killing a thread blocked in threadDelay.
+            gen.close()
+
+    async def _drive_gen(self, th: _Thread, gen: Any) -> Any:
         value: Any = None
         exc: Optional[BaseException] = None
         while True:
@@ -185,6 +194,15 @@ class RealTime:
                 value = th.tid
             elif type(eff) is Fork:
                 child = self._spawn(eff.program, th.log_name)
+                # forkIO-handoff parity with the emulator (des.py Fork:
+                # child enqueued at `now`, parent resumes at now+1, so
+                # the child reaches its first suspension first): yield
+                # the loop once so the child task runs to its first
+                # await. Fork is thereby a suspension point, and a
+                # stored async exception is deliverable here — exactly
+                # where the emulator's parent-resume event delivers it.
+                await asyncio.sleep(0)
+                exc = self._pop_exc(th)
                 value = child.tid
             elif type(eff) is ThrowTo:
                 # self-throw parity with the emulator: the exception is
@@ -252,8 +270,20 @@ class RealTime:
         try:
             await asyncio.wait({fut, wake},
                                return_when=asyncio.FIRST_COMPLETED)
+        except BaseException:
+            # Outer cancellation (task killed mid-await): don't leak the
+            # inner future — cancel it, reap it, then re-raise so
+            # _run_program's finally closes the program.
+            fut.cancel()
+            try:
+                await fut
+            except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                pass
+            raise
         finally:
             th.wake = None
+            if not wake.done():
+                wake.cancel()
         if th.pending_exc is not None:
             fut.cancel()
             try:
@@ -261,8 +291,6 @@ class RealTime:
             except (asyncio.CancelledError, Exception):  # noqa: BLE001
                 pass
             return None, self._pop_exc(th)
-        if not wake.done():
-            wake.cancel()
         try:
             return fut.result(), None
         except BaseException as e:  # noqa: BLE001 — surface in program

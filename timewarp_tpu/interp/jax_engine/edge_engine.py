@@ -133,6 +133,7 @@ class EdgeState(NamedTuple):
     q_valid: jax.Array     # bool[E, C, N]
     overflow: jax.Array    # int32[]
     unrouted: jax.Array    # int32[] — valid sends on undeclared slots
+    misrouted: jax.Array   # int32[] — out.dst disagreeing with static_dst
     bad_delay: jax.Array   # int32[] — delays >= 2^31 µs, clamped
     delivered: jax.Array   # int64[]
     steps: jax.Array       # int64[]
@@ -183,6 +184,7 @@ class EdgeEngine:
             q_valid=jnp.zeros((E, C, n), bool),
             overflow=jnp.int32(0),
             unrouted=jnp.int32(0),
+            misrouted=jnp.int32(0),
             bad_delay=jnp.int32(0),
             delivered=jnp.int64(0),
             steps=jnp.int64(0),
@@ -273,10 +275,16 @@ class EdgeEngine:
         out_valid = out.valid & fire[None, :]               # [M, N]
         out_pay = out.payload                                # [M, P, N]
         # never-silent contract: a valid send on a slot whose static_dst
-        # is -1 has nowhere to go — counted (≙ JaxEngine's bad_dst)
-        declared = comm.local_rows(
-            (np.asarray(sc.static_dst, np.int32) >= 0).T)    # [M, N]
+        # is -1 has nowhere to go — counted (≙ JaxEngine's bad_dst);
+        # and routing goes by the *declared* table, so a step emitting a
+        # dst that disagrees with its declaration is counted too rather
+        # than silently diverging from the oracle (which routes by dst)
+        sd_local = comm.local_rows(
+            np.asarray(sc.static_dst, np.int32).T)           # [M, N]
+        declared = sd_local >= 0
         unrouted_step = jnp.sum(out_valid & ~declared, dtype=jnp.int32)
+        misrouted_step = jnp.sum(
+            out_valid & declared & (out.dst != sd_local), dtype=jnp.int32)
 
         # 5. rebase surviving queue entries to the new epoch t
         keep = st.q_valid & ~deliver
@@ -347,6 +355,7 @@ class EdgeEngine:
             q_rel=q_rel, q_step=q_step, q_pay=q_pay, q_valid=q_valid,
             overflow=st.overflow + overflow_step,
             unrouted=st.unrouted + comm.all_sum(unrouted_step),
+            misrouted=st.misrouted + comm.all_sum(misrouted_step),
             bad_delay=st.bad_delay + comm.all_sum(bad_delay_total),
             delivered=st.delivered + recv_count.astype(jnp.int64),
             steps=st.steps + 1,

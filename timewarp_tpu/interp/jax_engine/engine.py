@@ -48,7 +48,7 @@ from ...net.delays import LinkModel
 from ...trace.events import SuperstepTrace
 from ...trace.hashing import FIRED, RECV, SENT, mix32_jnp
 from .common import I32MAX as _I32MAX
-from .common import LocalComm, StepOut as _StepOut
+from .common import StepOut as _StepOut
 from .common import thi as _thi, tlo as _tlo, u32sum as _u32sum
 
 __all__ = ["JaxEngine", "EngineState"]

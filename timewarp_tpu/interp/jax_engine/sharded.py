@@ -77,7 +77,11 @@ class MeshComm(LocalComm):
         return off + jnp.arange(self.n_local, dtype=jnp.int32)
 
     def all_min(self, x: jax.Array) -> jax.Array:
-        return jax.lax.pmin(x, self.axis)
+        # Not ``pmin``: the int64 min-all-reduce fails to lower on the
+        # TPU compiler path ("Supported lowering only of Sum all
+        # reduce"); gathering one scalar per device and reducing
+        # locally lowers everywhere and costs D words on ICI.
+        return jax.lax.all_gather(x, self.axis).min()
 
     def all_sum(self, x: jax.Array) -> jax.Array:
         return jax.lax.psum(x, self.axis)
@@ -129,12 +133,6 @@ class ShardedEdgeEngine(EdgeEngine):
         self.axis = axis
         D = mesh.shape[axis]
         self.comm = MeshComm(axis, scenario.n_nodes, D)
-        for e, s in enumerate(self.topo.shift):
-            if s[0] % self.comm.n_local == 0 and s[0] != 0 \
-                    and D > 1 and (s[0] // self.comm.n_local) % D == 0:
-                raise ValueError(
-                    f"edge {e} shift {s[0]} is a multiple of the global "
-                    "size per mesh ring — degenerate sharding")
 
     # -- sharding specs --------------------------------------------------
 
@@ -156,7 +154,7 @@ class ShardedEdgeEngine(EdgeEngine):
             q_step=leaf(st.q_step, True),
             q_pay=leaf(st.q_pay, True),
             q_valid=leaf(st.q_valid, True),
-            overflow=P(), unrouted=P(), bad_delay=P(),
+            overflow=P(), unrouted=P(), misrouted=P(), bad_delay=P(),
             delivered=P(), steps=P(), time=P(),
         )
 

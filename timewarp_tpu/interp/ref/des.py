@@ -34,7 +34,7 @@ from typing import Any, Dict, List, Optional
 from ...core.effects import (AwaitIO, Effect, Fork, GetLogName, GetTime,
                              MyTid, Park, Program, ProgramFn, SetLogName,
                              ThrowTo, Unpark, Wait)
-from ...core.errors import ThreadKilled, TimedError
+from ...core.errors import DeadlockError, TimedError
 from ..common import NO_TOKEN as _NO_TOKEN
 from ..common import log_thread_death
 from ...core.time import Microsecond, resolve
@@ -51,10 +51,6 @@ class PureThreadId:
 
     def __repr__(self) -> str:  # pragma: no cover - debug aid
         return f"PureThreadId({self.n})"
-
-
-#: sentinel: no unpark token pending
-_NO_TOKEN = object()
 
 
 @dataclass
@@ -113,21 +109,43 @@ class PureEmulation:
         self._push(main, self._time, None)
         main_result: List[Any] = []
         main_error: List[BaseException] = []
+        deadlock_served: set = set()
 
         # Event loop ≙ launchTimedT (TimedT.hs:234-286).
-        while self._queue:
-            entry = heapq.heappop(self._queue)
-            if entry[_CANCELLED]:
-                continue
-            th = self._threads[entry[_TID]]
-            th.resume_entry = None
-            if not th.alive:
-                continue
-            # Rewind the clock to the event's instant (TimedT.hs:247).
-            self._time = entry[_TIME]
-            # Deliver a pending async exception, if any (TimedT.hs:252-257).
-            exc = self._pending_exc.pop(th.tid, None)
-            self._step(th, entry[_VALUE], exc, main_result, main_error)
+        while True:
+            while self._queue:
+                entry = heapq.heappop(self._queue)
+                if entry[_CANCELLED]:
+                    continue
+                th = self._threads[entry[_TID]]
+                th.resume_entry = None
+                if not th.alive:
+                    continue
+                # Rewind the clock to the event's instant (TimedT.hs:247).
+                self._time = entry[_TIME]
+                # Deliver a pending async exception (TimedT.hs:252-257).
+                exc = self._pending_exc.pop(th.tid, None)
+                self._step(th, entry[_VALUE], exc, main_result, main_error)
+            # Queue drained. Parked survivors can never be woken again —
+            # deliver DeadlockError into each (≙ GHC's
+            # BlockedIndefinitelyOnMVar; handlers/finally still run) and
+            # keep looping until true quiescence. At most one delivery
+            # per thread: a handler that catches the error and parks
+            # again would otherwise be re-woken forever at frozen
+            # virtual time (GHC spins the same way, once per GC; we
+            # terminate instead).
+            parked = [th for th in self._threads.values()
+                      if th.alive and th.parked
+                      and th.tid not in deadlock_served]
+            if not parked:
+                break
+            for th in parked:
+                deadlock_served.add(th.tid)
+                th.parked = False
+                self._push(th, self._time, None)
+                self._pending_exc.setdefault(th.tid, DeadlockError(
+                    f"thread {th.tid} parked with no runnable events "
+                    "left — blocked indefinitely"))
 
         if main_error:
             raise main_error[0]
@@ -277,11 +295,7 @@ class PureEmulation:
             else:
                 main_result.append(result)
         elif exc is not None:
-            # ≙ threadKilledNotifier (TimedT.hs:306-316).
-            level = logging.DEBUG if isinstance(exc, ThreadKilled) \
-                else logging.WARNING
-            _log.log(level, "[%s] Thread killed by exception: %r",
-                     th.log_name, exc)
+            log_thread_death(_log, th.log_name, exc)
 
 
 def run_emulation(program_fn: ProgramFn, **kw: Any) -> Any:
