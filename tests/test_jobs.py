@@ -1,0 +1,213 @@
+"""JobCurator lifecycle semantics (≙ ``Control.TimeWarp.Manager.Job``)
+under BOTH interpreters — the dual-interpreter pattern of SURVEY.md §4.
+
+Reference semantics exercised: thread jobs killed by Plain interrupt
+with finally-cleanup (Job.hs:176-184), safe jobs surviving interrupt
+and self-terminating (Job.hs:189-193), WithTimeout watchdog escalating
+to Force (Job.hs:149-154), nested curators (Job.hs:168-173), and
+add-after-close immediate interruption (Job.hs:111-134).
+"""
+
+import pytest
+
+from timewarp_tpu.core.effects import Fork, GetTime, Wait
+from timewarp_tpu.interp.aio.timed import run_real_time
+from timewarp_tpu.interp.ref.des import run_emulation
+from timewarp_tpu.manage.jobs import Force, JobCurator, Plain, WithTimeout
+
+# Real-time runs scale virtual µs down so the suite stays fast; the
+# emulator uses the same numbers as pure virtual time.
+RUNNERS = [("emulation", run_emulation, 1.0),
+           ("realtime", run_real_time, 1.0)]
+
+
+def par(name):
+    return pytest.mark.parametrize(
+        "runner", [r for n, r, _ in RUNNERS], ids=[n for n, _, _ in RUNNERS])
+
+
+@par("runner")
+def test_thread_jobs_killed_and_awaited(runner):
+    log = []
+    jc = JobCurator()
+
+    def worker(i):
+        def prog():
+            try:
+                yield Wait(10_000_000)  # would be "forever"
+                log.append(f"w{i}-finished")
+            finally:
+                log.append(f"w{i}-cleanup")
+        return prog
+
+    def main():
+        for i in range(3):
+            yield from jc.add_thread_job(worker(i))
+        assert jc.job_count == 3
+        yield Wait(1_000)
+        yield from jc.stop_all_jobs()
+        assert jc.job_count == 0
+        assert jc.is_interrupted
+        return "done"
+
+    assert runner(main) == "done"
+    assert sorted(log) == ["w0-cleanup", "w1-cleanup", "w2-cleanup"]
+
+
+@par("runner")
+def test_safe_job_survives_plain_interrupt(runner):
+    log = []
+    jc = JobCurator()
+
+    def safe():
+        # polls is_interrupted; does a fixed amount of work after the
+        # interrupt to prove it wasn't killed
+        while not jc.is_interrupted:
+            yield Wait(500)
+        log.append("noticed")
+        yield Wait(500)
+        log.append("finished")
+
+    def main():
+        yield from jc.add_safe_thread_job(safe)
+        yield Wait(2_000)
+        yield from jc.stop_all_jobs()  # must wait for the safe job
+        return "done"
+
+    assert runner(main) == "done"
+    assert log == ["noticed", "finished"]
+
+
+@par("runner")
+def test_with_timeout_escalates_to_force(runner):
+    log = []
+    jc = JobCurator()
+
+    def stubborn():
+        # safe job that ignores interruption entirely
+        yield Wait(50_000)
+        log.append("stubborn-done")
+
+    def on_timeout():
+        log.append("timeout-fired")
+        yield GetTime()
+
+    def main():
+        yield from jc.add_safe_thread_job(stubborn)
+        yield Wait(1_000)
+        yield from jc.stop_all_jobs(WithTimeout(5_000, on_timeout))
+        # Force cleared the job before the thread finished
+        assert jc.job_count == 0
+        t = yield GetTime()
+        assert t < 40_000  # unblocked by the watchdog, not the job
+        return "done"
+
+    assert runner(main) == "done"
+    assert log[0] == "timeout-fired"
+
+
+@par("runner")
+def test_nested_curators(runner):
+    log = []
+    parent, child = JobCurator(), JobCurator()
+
+    def worker():
+        try:
+            yield Wait(10_000_000)
+        finally:
+            log.append("child-worker-cleanup")
+
+    def main():
+        yield from child.add_thread_job(worker)
+        yield from parent.add_manager_as_job(child)
+        yield Wait(1_000)
+        yield from parent.stop_all_jobs()
+        assert child.is_interrupted
+        assert child.job_count == 0
+        return "done"
+
+    assert runner(main) == "done"
+    assert log == ["child-worker-cleanup"]
+
+
+@par("runner")
+def test_add_after_close_immediately_interrupted(runner):
+    log = []
+    jc = JobCurator()
+
+    def never_runs():
+        log.append("ran")
+        yield Wait(1)
+
+    def main():
+        yield from jc.interrupt_all_jobs(Plain)
+        tid = yield from jc.add_thread_job(never_runs)
+        assert tid is not None  # a thread exists but its body was gated
+        yield Wait(1_000)
+        assert jc.job_count == 0
+        return "done"
+
+    assert runner(main) == "done"
+    assert log == []
+
+
+@par("runner")
+def test_interrupt_idempotent_and_force(runner):
+    jc = JobCurator()
+    killed = []
+
+    def worker():
+        try:
+            yield Wait(10_000_000)
+        finally:
+            killed.append(1)
+
+    def main():
+        yield from jc.add_thread_job(worker)
+        yield from jc.interrupt_all_jobs(Plain)
+        yield from jc.interrupt_all_jobs(Plain)  # idempotent no-op
+        yield from jc.interrupt_all_jobs(Force)  # clears regardless
+        assert jc.job_count == 0
+        yield from jc.await_all_jobs()  # returns instantly
+        return "done"
+
+    assert runner(main) == "done"
+
+
+@par("runner")
+def test_unless_interrupted(runner):
+    jc = JobCurator()
+    log = []
+
+    def action():
+        log.append("acted")
+        yield GetTime()
+
+    def main():
+        yield from jc.unless_interrupted(action)
+        yield from jc.interrupt_all_jobs(Plain)
+        yield from jc.unless_interrupted(action)
+        return len(log)
+
+    assert runner(main) == 1
+
+
+@par("runner")
+def test_safe_add_after_close_body_never_runs(runner):
+    """Reference contract (Job.hs:111-134): addJob on a closed curator
+    never starts the action — for safe jobs too."""
+    log = []
+    jc = JobCurator()
+
+    def safe():
+        log.append("ran")
+        yield Wait(1)
+
+    def main():
+        yield from jc.interrupt_all_jobs(Plain)
+        yield from jc.add_safe_thread_job(safe)
+        yield Wait(1_000)
+        return "done"
+
+    assert runner(main) == "done"
+    assert log == []

@@ -31,6 +31,7 @@ from .core.time import (FOREVER, Microsecond, after, at, for_, hour, mcs,
                         minute, ms, now, sec, till)
 from .interp.aio.timed import AioThreadId, RealTime, run_real_time
 from .interp.ref.des import PureEmulation, PureThreadId, run_emulation
+from .manage.jobs import Force, InterruptType, JobCurator, Plain, WithTimeout
 from .manage.sync import CLOSED, Channel, Flag, MVar
 
 __version__ = "0.1.0"

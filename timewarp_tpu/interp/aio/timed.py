@@ -169,8 +169,42 @@ class RealTime:
             # Runs the program's finally blocks even when the *task* is
             # cancelled out of a suspension point (e.g. scenario-exit
             # survivor cleanup) — GeneratorExit at the yield, exactly
-            # like GHC killing a thread blocked in threadDelay.
-            gen.close()
+            # like GHC killing a thread blocked in threadDelay. Cleanup
+            # code may still yield *instantaneous* effects (Unpark to
+            # release waiters, ThrowTo, time/tid reads); a suspension
+            # during cleanup aborts it.
+            self._close_gen(th, gen)
+
+    _INSTANT = (GetTime, MyTid, GetLogName, SetLogName, Unpark, ThrowTo)
+
+    def _close_gen(self, th: _Thread, gen: Any) -> None:
+        try:
+            eff = gen.throw(GeneratorExit)
+        except (StopIteration, GeneratorExit):
+            return
+        while True:
+            if type(eff) in self._INSTANT:
+                value: Any = None
+                if type(eff) is GetTime:
+                    value = self.virtual_time
+                elif type(eff) is MyTid:
+                    value = th.tid
+                elif type(eff) is GetLogName:
+                    value = th.log_name
+                elif type(eff) is SetLogName:
+                    th.log_name = eff.name
+                elif type(eff) is Unpark:
+                    self._unpark(eff.tid, eff.value)
+                elif type(eff) is ThrowTo:
+                    self._throw_to(eff.tid, eff.exc)
+                try:
+                    eff = gen.send(value)
+                except (StopIteration, GeneratorExit):
+                    return
+            else:
+                # tried to suspend during cleanup: hard stop
+                gen.close()
+                return
 
     async def _drive_gen(self, th: _Thread, gen: Any) -> Any:
         value: Any = None
