@@ -15,18 +15,19 @@ from timewarp_tpu.interp.aio.timed import run_real_time
 from timewarp_tpu.interp.ref.des import run_emulation
 from timewarp_tpu.manage.jobs import Force, JobCurator, Plain, WithTimeout
 
-# Real-time runs scale virtual µs down so the suite stays fast; the
-# emulator uses the same numbers as pure virtual time.
-RUNNERS = [("emulation", run_emulation, 1.0),
-           ("realtime", run_real_time, 1.0)]
+# The µs values below are virtual time under the emulator and real
+# wall-clock under asyncio — they are chosen small enough (≤50 ms, with
+# every long Wait interrupted early) that the realtime runs stay fast.
+RUNNERS = [("emulation", run_emulation),
+           ("realtime", run_real_time)]
 
 
-def par(name):
+def par():
     return pytest.mark.parametrize(
-        "runner", [r for n, r, _ in RUNNERS], ids=[n for n, _, _ in RUNNERS])
+        "runner", [r for _, r in RUNNERS], ids=[n for n, _ in RUNNERS])
 
 
-@par("runner")
+@par()
 def test_thread_jobs_killed_and_awaited(runner):
     log = []
     jc = JobCurator()
@@ -54,7 +55,7 @@ def test_thread_jobs_killed_and_awaited(runner):
     assert sorted(log) == ["w0-cleanup", "w1-cleanup", "w2-cleanup"]
 
 
-@par("runner")
+@par()
 def test_safe_job_survives_plain_interrupt(runner):
     log = []
     jc = JobCurator()
@@ -78,7 +79,7 @@ def test_safe_job_survives_plain_interrupt(runner):
     assert log == ["noticed", "finished"]
 
 
-@par("runner")
+@par()
 def test_with_timeout_escalates_to_force(runner):
     log = []
     jc = JobCurator()
@@ -96,17 +97,19 @@ def test_with_timeout_escalates_to_force(runner):
         yield from jc.add_safe_thread_job(stubborn)
         yield Wait(1_000)
         yield from jc.stop_all_jobs(WithTimeout(5_000, on_timeout))
-        # Force cleared the job before the thread finished
+        # Force cleared the job table before the thread finished: the
+        # stubborn job has not logged yet — structural evidence that the
+        # watchdog (not job completion) unblocked us, without depending
+        # on wall-clock bounds (flaky on loaded machines).
         assert jc.job_count == 0
-        t = yield GetTime()
-        assert t < 40_000  # unblocked by the watchdog, not the job
+        assert "stubborn-done" not in log
         return "done"
 
     assert runner(main) == "done"
     assert log[0] == "timeout-fired"
 
 
-@par("runner")
+@par()
 def test_nested_curators(runner):
     log = []
     parent, child = JobCurator(), JobCurator()
@@ -130,7 +133,7 @@ def test_nested_curators(runner):
     assert log == ["child-worker-cleanup"]
 
 
-@par("runner")
+@par()
 def test_add_after_close_immediately_interrupted(runner):
     log = []
     jc = JobCurator()
@@ -151,7 +154,7 @@ def test_add_after_close_immediately_interrupted(runner):
     assert log == []
 
 
-@par("runner")
+@par()
 def test_interrupt_idempotent_and_force(runner):
     jc = JobCurator()
     killed = []
@@ -174,7 +177,7 @@ def test_interrupt_idempotent_and_force(runner):
     assert runner(main) == "done"
 
 
-@par("runner")
+@par()
 def test_unless_interrupted(runner):
     jc = JobCurator()
     log = []
@@ -192,7 +195,7 @@ def test_unless_interrupted(runner):
     assert runner(main) == 1
 
 
-@par("runner")
+@par()
 def test_safe_add_after_close_body_never_runs(runner):
     """Reference contract (Job.hs:111-134): addJob on a closed curator
     never starts the action — for safe jobs too."""
@@ -211,3 +214,35 @@ def test_safe_add_after_close_body_never_runs(runner):
 
     assert runner(main) == "done"
     assert log == []
+
+
+@par()
+def test_with_timeout_on_already_interrupted_curator(runner):
+    """Reference contract (Job.hs:147-152): interruptAllJobs WithTimeout
+    forks its Force watchdog even when the curator was already
+    interrupted — a supervisor can impose a forced deadline on a
+    stuck, previously-Plain-interrupted curator."""
+    log = []
+    jc = JobCurator()
+
+    def stubborn():
+        # safe job that ignores interruption
+        yield Wait(60_000)
+        log.append("stubborn-done")
+
+    def on_timeout():
+        log.append("timeout-fired")
+        yield GetTime()
+
+    def main():
+        yield from jc.add_safe_thread_job(stubborn)
+        yield Wait(1_000)
+        yield from jc.interrupt_all_jobs(Plain)   # closes the curator
+        assert jc.job_count == 1                   # job ignores it
+        yield from jc.stop_all_jobs(WithTimeout(3_000, on_timeout))
+        assert jc.job_count == 0
+        assert "stubborn-done" not in log
+        return "done"
+
+    assert runner(main) == "done"
+    assert log[0] == "timeout-fired"

@@ -64,6 +64,20 @@ class PeerClosedConnection(TransferError):
     """Remote end closed the socket (≙ Transfer.hs:163-170)."""
 
 
+class ConnectError(TransferError):
+    """Connection could not be established — port unbound, peer
+    unreachable, or the link model dropped the connect attempt (≙ the
+    OS-level connect failure that feeds ``withRecovery``'s
+    ``reconnectPolicy`` loop, Transfer.hs:585-603, and the old API's
+    ``NeverConnected`` outcome)."""
+
+
+class SocketBroken(TransferError):
+    """The connection broke mid-stream — abrupt reset, not a clean EOF
+    (≙ the socket IOErrors that ``sfProcessSocket``'s workers surface to
+    ``withRecovery``, Transfer.hs:383-401)."""
+
+
 class MailboxOverflow(TimeWarpError):
     """A simulated node's bounded mailbox overflowed in the batched engine.
 

@@ -134,13 +134,11 @@ class JobCurator(_Waitable):
 
         def wrapped() -> Program:
             holder["tid"] = yield MyTid()
-            if self._closed:
+            jid = yield from self.add_job(interrupter)
+            if jid is None:
                 # ≙ addJob on a closed curator (Job.hs:111-134): the
-                # action never starts — safe or not
+                # interrupter ran; the action never starts.
                 return
-            jid = self._counter
-            self._counter += 1
-            self._jobs[jid] = interrupter
             try:
                 yield from program()
             finally:
@@ -160,12 +158,15 @@ class JobCurator(_Waitable):
         :attr:`is_interrupted` (≙ ``addSafeThreadJob``, Job.hs:189-193)."""
         return (yield from self._thread_job(program, safe=True))
 
-    def add_manager_as_job(self, child: "JobCurator") -> Program:
-        """Nest ``child``: interrupting this curator interrupts it, and
-        it counts as one job until all its own jobs finish
+    def add_manager_as_job(self, child: "JobCurator",
+                           itype: InterruptType = Plain) -> Program:
+        """Nest ``child``: interrupting this curator interrupts it (with
+        ``itype`` — the transport uses ``WithTimeout`` so a stuck
+        listener is Force-cleared at the deadline, Transfer.hs:301-305),
+        and it counts as one job until all its own jobs finish
         (≙ ``addManagerAsJob``, Job.hs:168-173)."""
         def interrupter() -> Program:
-            yield from child.interrupt_all_jobs(Plain)
+            yield from child.interrupt_all_jobs(itype)
 
         jid = yield from self.add_job(interrupter)
         if jid is None:
@@ -180,25 +181,23 @@ class JobCurator(_Waitable):
     # -- interruption ----------------------------------------------------
 
     def interrupt_all_jobs(self, itype: InterruptType = Plain) -> Program:
-        """≙ ``interruptAllJobs`` (Job.hs:138-154). Idempotent: a second
-        Plain/WithTimeout call is a no-op; Force always clears."""
-        if isinstance(itype, _Force):
-            first = not self._closed
+        """≙ ``interruptAllJobs`` (Job.hs:136-152). The Plain pass runs
+        interrupters once (second call is a no-op); Force additionally
+        clears the job table; WithTimeout arms its Force watchdog even
+        when the Plain pass was a no-op (the reference forks it
+        unconditionally, Job.hs:147-152 — so a supervisor can impose a
+        forced deadline on an already-interrupted curator)."""
+        if not self._closed:
             self._closed = True
-            jobs, self._jobs = dict(self._jobs), {}
+            jobs = dict(self._jobs)
             yield from self._notify()
-            if first:
-                for fn in jobs.values():
-                    yield from fn()
-            return
-        if self._closed:
-            return
-        self._closed = True
-        jobs = dict(self._jobs)
-        yield from self._notify()
-        for fn in jobs.values():
-            yield from fn()
-        if isinstance(itype, WithTimeout):
+            for fn in jobs.values():
+                yield from fn()
+        if isinstance(itype, _Force):
+            # ≙ Force: consider every remaining job done (Job.hs:144-146)
+            self._jobs.clear()
+            yield from self._notify()
+        elif isinstance(itype, WithTimeout):
             deadline, callback = itype.timeout_us, itype.on_timeout
 
             def watchdog() -> Program:

@@ -32,11 +32,11 @@ Vocabulary:
 from __future__ import annotations
 
 from collections import deque
-from typing import Any, Deque, List
+from typing import Any, Callable, Deque, List
 
 from ..core.effects import MyTid, Park, Program, Unpark
 
-__all__ = ["Flag", "MVar", "Channel", "CLOSED"]
+__all__ = ["Flag", "MVar", "Channel", "CLOSED", "wait_until"]
 
 
 class _Waitable:
@@ -176,8 +176,39 @@ class Channel(_Waitable):
                 return CLOSED
             yield from self._await_change()
 
+    def unget(self, item: Any) -> Program:
+        """Prepend ``item``, ignoring capacity (≙ ``unGetTBMChan`` — the
+        transport's send worker pushes a chunk back on socket error,
+        Transfer.hs:387-388)."""
+        self._items.appendleft(item)
+        yield from self._notify()
+
     def close(self) -> Program:
         """Close: pending items remain readable; blocked ops re-check
         (≙ ``closeTBMChan``)."""
         self._closed = True
         yield from self._notify()
+
+    def drain(self) -> None:
+        """Discard all pending items (≙ the ``clearInChan`` loop in
+        ``sfClose``, Transfer.hs:328-330)."""
+        self._items.clear()
+
+
+def wait_until(pred: Callable[[], bool], *waitables: _Waitable) -> Program:
+    """Block until ``pred()`` holds, re-checking whenever any of the
+    ``waitables`` notifies — the analogue of an STM transaction retrying
+    over several ``TVar``\\ s (e.g. ``sfSend`` blocks on "sent-notifier
+    fired ∨ socket closed", Transfer.hs:266-271)."""
+    while not pred():
+        tid = yield MyTid()
+        for w in waitables:
+            w._waiters.append(tid)
+        try:
+            yield Park()
+        finally:
+            for w in waitables:
+                try:
+                    w._waiters.remove(tid)
+                except ValueError:
+                    pass
