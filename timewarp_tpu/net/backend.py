@@ -51,10 +51,16 @@ __all__ = [
 NetworkAddress = Tuple[str, int]
 
 
-def _crc(name: str) -> int:
-    """Stable uint32 id for an endpoint name — feeds the counter-based
-    RNG the way node indices do in the batched engines."""
+def endpoint_id(name: str) -> int:
+    """Stable uint32 id for an endpoint name (``"host:port"``) — feeds
+    the counter-based RNG the way node indices do in the batched
+    engines, and lets link models address endpoints (e.g. the token-ring
+    delays spec giving observer-bound traffic zero latency,
+    examples/token-ring/Main.hs:73-77)."""
     return zlib.crc32(name.encode()) & 0xFFFFFFFF
+
+
+_crc = endpoint_id
 
 
 class RawSocket:

@@ -166,10 +166,17 @@ class Dialog:
                 try:
                     packets = parser.feed(data)
                 except ParseError as e:
-                    # ≙ handleE: log, stop this connection's listening
-                    # (MonadDialog.hs:258-259)
+                    # ≙ handleE: log and stop this connection's
+                    # listening (MonadDialog.hs:258-259) — and CLOSE the
+                    # frame: a desynced byte stream cannot recover, and
+                    # closing pops the connection from the pool so the
+                    # next send/call re-creates it with a fresh parser
+                    # (the reference notes this as open debt, TW-59,
+                    # Transfer.hs:57-59 — "socket gets closed; need to
+                    # make it reconnect"; eviction does exactly that).
                     _log.warning("error parsing message from %s: %r",
                                  resp.peer_addr, e)
+                    yield from ctx.close()
                     return
                 for packet in packets:
                     yield from self._process_packet(
@@ -193,8 +200,12 @@ class Dialog:
         li = table.get(name)
         if li is None:
             # ≙ unknown-name warning + raw-listener-only path
-            # (MonadDialog.hs:241-245)
-            _log.warning("no listener with name %s defined", name)
+            # (MonadDialog.hs:241-245). With an *empty* typed table the
+            # caller is deliberately raw-listening (transferScenario
+            # style / the RPC response listener) — no misconfiguration
+            # to warn about.
+            if table:
+                _log.warning("no listener with name %s defined", name)
             if raw_listener is not None:
                 def raw_only() -> Program:
                     yield from self._invoke_raw(raw_listener, header,

@@ -217,7 +217,11 @@ def _dec(buf: bytes, i: int) -> Tuple[Any, int]:
         d = {}
         for _ in range(n):
             klen, i = _dec_varint(buf, i)
-            k, _ = _dec(buf[i:i + klen], 0)
+            k, used = _dec(buf[i:i + klen], 0)
+            if used != klen:
+                # canonical-encoding contract: the key must fill its
+                # declared length exactly (≙ checkAllConsumed)
+                raise ParseError(f"dict key: {klen - used} stray bytes")
             i += klen
             v, i = _dec(buf, i)
             d[k] = v

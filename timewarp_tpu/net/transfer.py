@@ -300,6 +300,19 @@ class Transport:
         sf = yield from self._get_out_conn(addr)
         return sf.user_state
 
+    def pooled(self, addr: NetworkAddress) -> Optional[SocketFrame]:
+        """The live pooled outbound connection, or None — lets layers
+        above detect that a connection was torn down and re-created
+        (the RPC client re-attaches its response listener then)."""
+        return self._pool.get(addr)
+
+    def close_all(self) -> Program:
+        """Close every pooled outbound connection — the teardown the
+        reference leaves as debt (TW-67, Transfer.hs:31: "close all
+        connections upon quiting")."""
+        for addr in list(self._pool):
+            yield from self.close(addr)
+
     # -- server side (≙ listenInbound, Transfer.hs:467-527) --------------
 
     def _listen_inbound(self, port: int,
