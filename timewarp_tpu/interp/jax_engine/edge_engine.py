@@ -88,6 +88,39 @@ class EdgeTopology(NamedTuple):
         if np.any(sd[used] >= n):
             raise ValueError("static_dst contains out-of-range destination")
         M = sd.shape[1]
+        # slot-major fast path: when every declared outbox column is a
+        # uniform ring shift, make each column one shift edge directly.
+        # The receiver-centric lexsort below ranks in-edges by (src,
+        # slot) per receiver, and that ranking is NOT a uniform shift at
+        # the ring wrap (receiver 1's smallest src may come from a
+        # different column than receiver 20's) — which would wrongly
+        # disqualify multi-shift topologies from the ppermute engine.
+        # Inbox-order semantics don't depend on edge rank: the contract
+        # #2 sort keys on actual (src, slot).
+        ids64 = np.arange(n, dtype=np.int64)
+        col_shift: List[Optional[int]] = []
+        for k in range(M):
+            col = sd[:, k]
+            if (col < 0).all():
+                col_shift.append(-1)        # unused column: skip
+            elif (col >= 0).all():
+                d = (col.astype(np.int64) - ids64) % n
+                col_shift.append(int(d[0]) if (d == d[0]).all() else None)
+            else:
+                col_shift.append(None)      # partially declared
+        if all(s is not None for s in col_shift) \
+                and any(s != -1 for s in col_shift):
+            cols = [k for k in range(M) if col_shift[k] != -1]
+            E = len(cols)
+            in_valid = np.ones((E, n), bool)
+            in_src = np.stack([
+                ((ids64 - col_shift[k]) % n).astype(np.int32)
+                for k in cols])
+            in_slot = np.stack([np.full(n, k, np.int32) for k in cols])
+            in_flat = in_slot * np.int32(n) + in_src
+            shift = [(int(col_shift[k]), k) for k in cols]
+            return EdgeTopology(E, in_valid, in_src, in_slot, in_flat,
+                                shift)
         # vectorized graph inversion: flatten (src, slot) pairs, order by
         # (dst, src, slot) — sender-major within each receiver
         flat = sd.ravel()
@@ -235,16 +268,22 @@ class EdgeEngine:
             src_rows[:, None, :], (E, C, n)).reshape(W, n)
         ipay = st.q_pay.reshape(W, P, n)
         if not sc.commutative_inbox:
-            # contract #2 order: (deliver_time, insert_step, sender-major
-            # edge rank); one variadic sort along the slot axis
-            erank = jnp.broadcast_to(
-                jnp.arange(E, dtype=jnp.int32)[:, None, None],
-                (E, C, n)).reshape(W, n)
+            # contract #2 order: (deliver_time, insert_step, src, slot)
+            # — the oracle's arrival order is chronological routing
+            # order, i.e. step-major then sender-major then slot; one
+            # variadic sort along the inbox-slot axis restores it
+            slot_rows = jnp.stack([
+                jnp.full((n,), topo.shift[e][1], jnp.int32)
+                if topo.shift[e] is not None
+                else comm.local_rows(topo.in_slot[e])
+                for e in range(E)], axis=0)                  # int32[E, n]
+            islot = jnp.broadcast_to(
+                slot_rows[:, None, :], (E, C, n)).reshape(W, n)
             ops = jax.lax.sort(
-                (~iv, rel, istep, erank, isrc) + tuple(
+                (~iv, rel, istep, isrc, islot) + tuple(
                     ipay[:, p, :] for p in range(P)),
-                dimension=0, num_keys=4)
-            iv, rel, isrc = ~ops[0], ops[1], ops[4]
+                dimension=0, num_keys=5)
+            iv, rel, isrc = ~ops[0], ops[1], ops[3]
             ipay = jnp.stack(ops[5:5 + P], axis=1)
         itime = jnp.where(iv, base + rel.astype(jnp.int64),
                           jnp.int64(NEVER))

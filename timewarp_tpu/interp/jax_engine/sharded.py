@@ -6,27 +6,21 @@ topologies (the token ring's neighbor exchange), ``all_to_all`` for
 dynamic destinations — instead of the reference's TCP sockets
 (`/root/reference/src/Control/TimeWarp/Rpc/Transfer.hs:473,577`).
 
-Two engines, one per delivery pattern:
-
-- :class:`ShardedEdgeEngine` — the edge engine (edge_engine.py) run
-  under ``shard_map`` with the node axis sharded. All communication
-  goes through :class:`MeshComm`: the global clock min is a ``pmin``,
-  counters and trace digests are ``psum`` (the digests are *wrapping
-  uint32 sums*, so the cross-device reduction is exact, not
-  approximate), and the ring delivery roll becomes a boundary-slice
-  ``ppermute`` — one neighbor hop over ICI per superstep, never an
-  all-gather. Requires a pure-shift topology (every edge a constant
-  ring offset); anything else needs cross-shard gathers and belongs to
-  the all_to_all engine.
-- :class:`ShardedEngine` — the general engine (engine.py) with its
-  routing stage replaced by destination-shard bucketing + one
-  ``lax.all_to_all`` exchange per superstep, with per-(src-shard,
-  dst-shard) bucket capacity; bucket overflow is counted, never
-  silent.
+:class:`ShardedEdgeEngine` is the edge engine (edge_engine.py) run
+under ``shard_map`` with the node axis sharded. All communication goes
+through :class:`MeshComm`: the global clock min is an ``all_gather`` +
+local reduce, counters and trace digests are ``psum`` (the digests are
+*wrapping uint32 sums*, so the cross-device reduction is exact, not
+approximate), and the ring delivery roll becomes a boundary-slice
+``ppermute`` — one neighbor hop over ICI per superstep, never an
+all-gather of the payload arrays. Requires a pure-shift topology
+(every edge a constant ring offset); anything else needs cross-shard exchange bucketed by
+destination shard (``lax.all_to_all``) — the general sharded engine.
 
 The acceptance law is unchanged: an 8-device run must reproduce the
-1-device trace **bit-for-bit** (tests/test_sharded.py runs both
-engines on a virtual 8-device CPU mesh against the host oracle).
+1-device trace **bit-for-bit** (tests/test_sharded.py runs the engine
+on a virtual 8-device CPU mesh against both the 1-device engine and
+the host oracle).
 """
 
 from __future__ import annotations
@@ -127,8 +121,8 @@ class ShardedEdgeEngine(EdgeEngine):
         if bad:
             raise ValueError(
                 f"edges {bad} are not pure shifts; the sharded edge "
-                "engine delivers by ppermute only — use the all_to_all "
-                "ShardedEngine for irregular topologies")
+                "engine delivers by ppermute only — irregular "
+                "topologies need the all_to_all general sharded engine")
         self.mesh = mesh
         self.axis = axis
         D = mesh.shape[axis]

@@ -179,16 +179,20 @@ class Rpc:
         first calls race here: the intent is recorded synchronously
         (pre-yield) so exactly one attaches, the rest wait on its flag
         (single-listener rule)."""
-        current = self.dialog.transport.pooled(addr)
-        st = self._listened.get(addr)
-        if st is not None:
-            if st["attaching"]:
+        while True:
+            st = self._listened.get(addr)
+            if st is not None and st["attaching"]:
+                # someone is attaching right now: wait, then RE-CHECK —
+                # the state we wake to may itself be mid-attach again,
+                # and falling through here would double-attach and trip
+                # the single-listener rule
                 yield from st["flag"].wait()
-                st = self._listened.get(addr)
-                current = self.dialog.transport.pooled(addr)
+                continue
+            current = self.dialog.transport.pooled(addr)
             if (st is not None and st["frame"] is not None
                     and st["frame"] is current):
                 return
+            break
 
         def on_response(hr: Tuple[Any, bytes], ctx: DialogCtx) -> Program:
             header, raw = hr
