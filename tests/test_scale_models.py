@@ -1,0 +1,95 @@
+"""Gossip (BASELINE config 4) and Praos (config 5) scenarios: trace
+parity at small n across oracle / 1-device general engine / 8-device
+all_to_all sharded engine, plus behavioral sanity (the rumor actually
+spreads; the chain actually grows)."""
+
+import jax
+import numpy as np
+
+from timewarp_tpu.interp.jax_engine.engine import JaxEngine
+from timewarp_tpu.interp.jax_engine.sharded import ShardedEngine, make_mesh
+from timewarp_tpu.interp.ref.superstep import SuperstepOracle
+from timewarp_tpu.models.gossip import gossip, gossip_links
+from timewarp_tpu.models.praos import praos
+from timewarp_tpu.net.delays import UniformDelay, WithDrop
+from timewarp_tpu.trace.events import assert_traces_equal
+
+
+def three_way(sc, link, steps):
+    ot = SuperstepOracle(sc, link).run(10 * steps)
+    lst, lt = JaxEngine(sc, link).run(steps)
+    sst, st = ShardedEngine(sc, link, make_mesh(8)).run(steps)
+    assert_traces_equal(ot, lt, "oracle", "local", limit=len(lt))
+    assert_traces_equal(ot, st, "oracle", "sharded", limit=len(st))
+    return lst, lt
+
+
+def test_gossip_lognormal_parity_and_spread():
+    """LogNormalDelay finally under parity load (float model; CPU
+    parity per its documented contract)."""
+    sc = gossip(64, fanout=6, think_us=3_000, gossip_interval=1_000,
+                end_us=5_000_000)
+    link = gossip_links(median_us=20_000, sigma=0.6)
+    fst, lt = three_way(sc, link, 700)
+    # every node heard the rumor
+    hops = np.asarray(jax.device_get(fst.states["hop"]))
+    assert (hops >= 0).all(), f"{(hops < 0).sum()} nodes never infected"
+    assert int(fst.overflow) == 0
+    assert lt.total_delivered() > 250  # most of the 64*6 sends landed
+
+
+def test_gossip_with_drop_parity():
+    sc = gossip(48, fanout=8, think_us=2_000, gossip_interval=1_500,
+                end_us=3_000_000)
+    link = WithDrop(UniformDelay(5_000, 40_000), 0.2)
+    fst, _ = three_way(sc, link, 600)
+    hops = np.asarray(jax.device_get(fst.states["hop"]))
+    assert (hops >= 0).mean() > 0.9  # drops may strand a few
+
+
+def test_praos_parity_and_chain_growth():
+    sc = praos(64, slot_us=100_000, n_slots=3, leader_prob=0.05,
+               fanout=6, relay_interval=2_000)
+    link = UniformDelay(3_000, 25_000)
+    fst, lt = three_way(sc, link, 4000)
+    best = np.asarray(jax.device_get(fst.states["best"]))
+    slots = np.asarray(jax.device_get(fst.states["slot"]))
+    assert (slots == 3).all()        # every node saw every slot
+    assert best.max() >= 2           # E[leaders/slot]=3.2: chain grew
+    # consensus: most nodes converged on the longest chain
+    assert (best == best.max()).mean() > 0.8
+    assert lt.total_delivered() > 100
+
+
+def test_praos_leadership_is_deterministic():
+    """Same seed -> identical chain; different seed -> (almost surely)
+    different leadership schedule."""
+    sc = praos(32, slot_us=50_000, n_slots=4, leader_prob=0.1,
+               fanout=4, relay_interval=1_000)
+    link = UniformDelay(1_000, 9_000)
+    a, _ = JaxEngine(sc, link, seed=0).run(400)
+    b, _ = JaxEngine(sc, link, seed=0).run(400)
+    c, _ = JaxEngine(sc, link, seed=7).run(400)
+    ba = np.asarray(jax.device_get(a.states["best"]))
+    bb = np.asarray(jax.device_get(b.states["best"]))
+    bc = np.asarray(jax.device_get(c.states["best"]))
+    assert np.array_equal(ba, bb)
+    assert not np.array_equal(ba, bc)
+
+
+def test_sharded_general_run_quiet_matches_traced():
+    """The general sharded engine's while_loop driver (the bench path)
+    must agree with its traced scan driver."""
+    sc = praos(64, slot_us=50_000, n_slots=2, leader_prob=0.05,
+               fanout=4, relay_interval=1_000)
+    link = UniformDelay(2_000, 9_000)
+    eng = ShardedEngine(sc, link, make_mesh(8))
+    traced_final, _ = eng.run(2000)
+    quiet_final = eng.run_quiet(2000)
+    for name in ("delivered", "steps", "time", "overflow", "bad_dst"):
+        assert int(getattr(traced_final, name)) == \
+            int(getattr(quiet_final, name)), name
+    for k in traced_final.states:
+        assert np.array_equal(
+            np.asarray(jax.device_get(traced_final.states[k])),
+            np.asarray(jax.device_get(quiet_final.states[k]))), k

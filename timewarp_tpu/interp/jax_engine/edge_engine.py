@@ -431,6 +431,16 @@ class EdgeEngine:
 
     # -- drivers ---------------------------------------------------------
 
+    def _next_event(self, carry: EdgeState) -> jax.Array:
+        """This device's next event time (NEVER = quiesced) — the
+        while-loop condition shared by the local and sharded drivers."""
+        qmin = jnp.where(carry.q_valid, carry.q_rel, _I32MAX).min()
+        return jnp.minimum(
+            carry.wake.min(),
+            jnp.where(qmin < _I32MAX,
+                      carry.time + qmin.astype(jnp.int64),
+                      jnp.int64(NEVER)))
+
     @partial(jax.jit, static_argnums=(0, 2))
     def _run_scan(self, st: EdgeState, max_steps: int):
         def body(carry, _):
@@ -457,12 +467,7 @@ class EdgeEngine:
         max_steps = jnp.asarray(max_steps, jnp.int64)
 
         def cond(carry):
-            qmin = jnp.where(carry.q_valid, carry.q_rel, _I32MAX).min()
-            has_q = qmin < _I32MAX
-            nxt = self.comm.all_min(jnp.minimum(
-                carry.wake.min(),
-                jnp.where(has_q, carry.time + qmin.astype(jnp.int64),
-                          jnp.int64(NEVER))))
+            nxt = self.comm.all_min(self._next_event(carry))
             return (nxt < NEVER) & (carry.steps - start_steps < max_steps)
 
         def body(carry):

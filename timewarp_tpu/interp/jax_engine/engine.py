@@ -48,7 +48,7 @@ from ...net.delays import LinkModel
 from ...trace.events import SuperstepTrace
 from ...trace.hashing import FIRED, RECV, SENT, mix32_jnp
 from .common import I32MAX as _I32MAX
-from .common import StepOut as _StepOut
+from .common import LocalComm, StepOut as _StepOut
 from .common import thi as _thi, tlo as _tlo, u32sum as _u32sum
 
 __all__ = ["JaxEngine", "EngineState"]
@@ -91,6 +91,7 @@ class JaxEngine:
         self.scenario = scenario
         self.link = link
         self.s0, self.s1 = seed_words(seed)
+        self.comm = LocalComm(scenario.n_nodes)
 
     # -- initial state ---------------------------------------------------
 
@@ -123,11 +124,24 @@ class JaxEngine:
 
     # -- one superstep ---------------------------------------------------
 
+    def _exchange(self, ok, drel, src_f, dst_f, pay_f):
+        """Hand routed messages to the device that owns their
+        destination, returning ``(ok, drel, src, local_row, payload,
+        bucket_overflow)`` for the messages *this* device's nodes will
+        receive. Single chip: identity — the global destination id is
+        the local mailbox row. The sharded engine (sharded.py)
+        overrides this with destination-shard bucketing + one
+        ``lax.all_to_all``; bucket overflow is counted, never silent.
+        ``dst_f`` is the global destination, already validated."""
+        return ok, drel, src_f, dst_f, pay_f, jnp.int32(0)
+
     def _superstep(self, st: EngineState, with_trace: bool
                    ) -> Tuple[EngineState, Optional[_StepOut]]:
-        sc = self.scenario
-        n, K, M, P = sc.n_nodes, sc.mailbox_cap, sc.max_out, sc.payload_width
-        node_ids = jnp.arange(n, dtype=jnp.int32)
+        sc, comm = self.scenario, self.comm
+        K, M, P = sc.mailbox_cap, sc.max_out, sc.payload_width
+        n = comm.n_local            # array width on this device
+        n_glob = comm.n_global
+        node_ids = comm.node_ids()  # global identities, int32[n]
         base = st.time
 
         # 1. global next event time (the batched "pop min", TimedT.hs:241-245)
@@ -137,7 +151,7 @@ class JaxEngine:
             st.wake,
             jnp.where(nnr == _I32MAX, jnp.int64(NEVER),
                       base + nnr.astype(jnp.int64)))
-        t = node_next.min()
+        t = comm.all_min(node_next.min())
         live = t < NEVER
         fire = (node_next == t) & live
         shift32 = jnp.minimum(t - base,
@@ -206,36 +220,45 @@ class JaxEngine:
         mbits = msg_bits(self.s0, self.s1, src_f, dst_f, t, slot_f) \
             if self.link.needs_key else None
         delay, drop = self.link.sample(src_f, dst_f, t, mbits)
-        dst_ok = (dst_f >= 0) & (dst_f < n)
+        dst_ok = (dst_f >= 0) & (dst_f < n_glob)
         ok = v_f & ~drop & dst_ok
         # contract #6 corollary: a scenario emitting an out-of-range
         # destination is a bug — surfaced, never silently dropped
-        bad_dst_step = jnp.sum(v_f & ~dst_ok, dtype=jnp.int32)
+        bad_dst_step = comm.all_sum(
+            jnp.sum(v_f & ~dst_ok, dtype=jnp.int32))
         drel64 = jnp.maximum(delay, jnp.int64(1))  # contract #4
-        bad_delay_step = jnp.sum(
-            ok & (drel64 > jnp.int64(_I32MAX - 1)), dtype=jnp.int32)
+        bad_delay_step = comm.all_sum(jnp.sum(
+            ok & (drel64 > jnp.int64(_I32MAX - 1)), dtype=jnp.int32))
         drel = jnp.minimum(drel64, jnp.int64(_I32MAX - 1)).astype(jnp.int32)
+
+        # 6.5. hand each message to the device that owns its destination
+        # (identity single-chip; bucket + all_to_all sharded) — rows come
+        # back device-local
+        ok_r, drel_r, src_r, row_r, pay_r, bucket_ovf = self._exchange(
+            ok, drel, src_f, dst_f, pay_f)
+        S_r = ok_r.shape[0]
 
         # 7. insert: stable sort by destination; rank within destination
         #    = sender-major arrival order; bounded by mailbox capacity
-        sort_dst = jnp.where(ok, dst_f, n)  # invalid -> sentinel row n
+        sort_dst = jnp.where(ok_r, row_r, n)  # invalid -> sentinel row n
         perm3 = jnp.argsort(sort_dst, stable=True)
         sd = sort_dst[perm3]
-        rank = jnp.arange(S, dtype=jnp.int32) - jnp.searchsorted(
+        rank = jnp.arange(S_r, dtype=jnp.int32) - jnp.searchsorted(
             sd, sd, side="left").astype(jnp.int32)
         base_cnt = counts[jnp.clip(sd, 0, n - 1)]
         pos = base_cnt + rank
-        ok_s = ok[perm3]
+        ok_s = ok_r[perm3]
         fits = ok_s & (pos < K)
         row = jnp.where(fits, sd, n)  # out-of-range row -> dropped scatter
         col = jnp.clip(pos, 0, K - 1)
-        mb_rel = mb_rel.at[row, col].set(drel[perm3], mode="drop")
-        mb_src = mb_src.at[row, col].set(src_f[perm3], mode="drop")
-        mb_payload = mb_payload.at[row, col].set(pay_f[perm3], mode="drop")
+        mb_rel = mb_rel.at[row, col].set(drel_r[perm3], mode="drop")
+        mb_src = mb_src.at[row, col].set(src_r[perm3], mode="drop")
+        mb_payload = mb_payload.at[row, col].set(pay_r[perm3], mode="drop")
         mb_valid = mb_valid.at[row, col].set(fits, mode="drop")
-        overflow_step = jnp.sum(ok_s & (pos >= K), dtype=jnp.int32)
+        overflow_step = comm.all_sum(
+            jnp.sum(ok_s & (pos >= K), dtype=jnp.int32)) + bucket_ovf
 
-        recv_count = jnp.sum(deliver, dtype=jnp.int32)
+        recv_count = comm.all_sum(jnp.sum(deliver, dtype=jnp.int32))
         new_st = EngineState(
             states=states, wake=wake,
             mb_rel=mb_rel, mb_src=mb_src, mb_payload=mb_payload,
@@ -254,23 +277,25 @@ class JaxEngine:
 
         # 8. trace digests (order-independent — trace/hashing.py);
         # computed from the pre-sort deliver mask: the uint32 sum is
-        # commutative, so this equals the sorted-inbox digest
-        fired_hash = _u32sum(jnp.where(fire, mix32_jnp(FIRED, node_ids), 0))
+        # commutative, so this equals the sorted-inbox digest (and makes
+        # the cross-device psum exact)
+        fired_hash = comm.all_sum(
+            _u32sum(jnp.where(fire, mix32_jnp(FIRED, node_ids), 0)))
         d_abs = base + jnp.where(deliver, st.mb_rel, 0).astype(jnp.int64)
         recv_mix = mix32_jnp(
             RECV, jnp.broadcast_to(node_ids[:, None], (n, K)),
             st.mb_src, _tlo(d_abs), _thi(d_abs),
             st.mb_payload[:, :, 0])
-        recv_hash = _u32sum(jnp.where(deliver, recv_mix, 0))
+        recv_hash = comm.all_sum(_u32sum(jnp.where(deliver, recv_mix, 0)))
         dt_abs = t + drel64
         sent_mix = mix32_jnp(SENT, src_f, dst_f, _tlo(dt_abs), _thi(dt_abs),
                              pay_f[:, 0])
-        sent_hash = _u32sum(jnp.where(ok, sent_mix, 0))
-        sent_count = jnp.sum(ok, dtype=jnp.int32)
+        sent_hash = comm.all_sum(_u32sum(jnp.where(ok, sent_mix, 0)))
+        sent_count = comm.all_sum(jnp.sum(ok, dtype=jnp.int32))
 
         yrow = _StepOut(
             valid=live, t=t,
-            fired_count=jnp.sum(fire, dtype=jnp.int32),
+            fired_count=comm.all_sum(jnp.sum(fire, dtype=jnp.int32)),
             fired_hash=fired_hash,
             recv_count=recv_count, recv_hash=recv_hash,
             sent_count=sent_count, sent_hash=sent_hash,
@@ -305,6 +330,15 @@ class JaxEngine:
             np.asarray(ys.sent_hash)[m], np.asarray(ys.overflow)[m]))
         return final, SuperstepTrace.from_rows(rows)
 
+    def _next_event(self, carry: EngineState) -> jax.Array:
+        """This device's next event time (NEVER = quiesced) — the
+        while-loop condition shared by the local and sharded drivers."""
+        mmin = jnp.where(carry.mb_valid, carry.mb_rel, _I32MAX).min()
+        return jnp.minimum(
+            carry.wake.min(),
+            jnp.where(mmin == _I32MAX, jnp.int64(NEVER),
+                      carry.time + mmin.astype(jnp.int64)))
+
     @partial(jax.jit, static_argnums=(0,))
     def _run_while(self, st: EngineState, max_steps) -> EngineState:
         # max_steps is traced (a device scalar), so benchmarking with
@@ -313,11 +347,7 @@ class JaxEngine:
         max_steps = jnp.asarray(max_steps, jnp.int64)
 
         def cond(carry):
-            mmin = jnp.where(carry.mb_valid, carry.mb_rel, _I32MAX).min()
-            nxt = jnp.minimum(
-                carry.wake.min(),
-                jnp.where(mmin == _I32MAX, jnp.int64(NEVER),
-                          carry.time + mmin.astype(jnp.int64)))
+            nxt = self.comm.all_min(self._next_event(carry))
             return (nxt < NEVER) & (carry.steps - start_steps < max_steps)
 
         def body(carry):

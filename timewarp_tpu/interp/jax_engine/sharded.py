@@ -39,8 +39,9 @@ from ...core.scenario import Scenario
 from ...net.delays import LinkModel
 from .common import LocalComm
 from .edge_engine import EdgeEngine, EdgeState
+from .engine import EngineState, JaxEngine
 
-__all__ = ["MeshComm", "ShardedEdgeEngine", "make_mesh"]
+__all__ = ["MeshComm", "ShardedEdgeEngine", "ShardedEngine", "make_mesh"]
 
 
 def make_mesh(n_devices: Optional[int] = None,
@@ -107,11 +108,62 @@ class MeshComm(LocalComm):
             jnp.asarray(table), off, self.n_local, axis=-1)
 
 
-class ShardedEdgeEngine(EdgeEngine):
+class _ShardedDriver:
+    """Shared ``shard_map`` driver for the sharded engines: state
+    placement with ``NamedSharding`` (so XLA keeps every per-node array
+    resident on its owning device across the whole loop), and the
+    jitted scan / while_loop wrappers. The concrete engine supplies
+    ``_state_specs`` (its state's PartitionSpecs), ``_superstep``, and
+    ``_next_event`` (the quiescence expression, inherited from its
+    local base class)."""
+
+    def init_state(self):
+        st = super().init_state()
+        specs = self._state_specs(st)
+        return jax.tree.map(
+            lambda x, s: jax.device_put(x, NamedSharding(self.mesh, s)),
+            st, specs)
+
+    @partial(jax.jit, static_argnums=(0, 2))
+    def _run_scan(self, st, max_steps: int):
+        specs = self._state_specs(st)
+
+        def body(s):
+            def step(carry, _):
+                return self._superstep(carry, True)
+            return jax.lax.scan(step, s, None, length=max_steps)
+
+        return jax.shard_map(
+            body, mesh=self.mesh, in_specs=(specs,),
+            out_specs=(specs, P()), check_vma=False)(st)
+
+    @partial(jax.jit, static_argnums=(0,))
+    def _run_while(self, st, max_steps):
+        from ...core.scenario import NEVER
+
+        specs = self._state_specs(st)
+        max_steps = jnp.asarray(max_steps, jnp.int64)
+
+        def body_fn(s, ms):
+            start_steps = s.steps
+
+            def cond(carry):
+                nxt = self.comm.all_min(self._next_event(carry))
+                return (nxt < NEVER) & (carry.steps - start_steps < ms)
+
+            def body(carry):
+                return self._superstep(carry, False)[0]
+
+            return jax.lax.while_loop(cond, body, s)
+
+        return jax.shard_map(
+            body_fn, mesh=self.mesh, in_specs=(specs, P()),
+            out_specs=specs, check_vma=False)(st, max_steps)
+
+
+class ShardedEdgeEngine(_ShardedDriver, EdgeEngine):
     """Edge engine over a mesh: node axis sharded, ring delivery on
-    ``ppermute``. Same ``run`` / ``run_quiet`` API; states are placed
-    with ``NamedSharding`` so XLA keeps every per-node array resident
-    on its owning device across the whole ``while_loop``."""
+    ``ppermute``. Same ``run`` / ``run_quiet`` API as the local engine."""
 
     def __init__(self, scenario: Scenario, link: LinkModel,
                  mesh: Mesh, *, axis: str = "nodes", seed: int = 0,
@@ -152,53 +204,99 @@ class ShardedEdgeEngine(EdgeEngine):
             delivered=P(), steps=P(), time=P(),
         )
 
-    def init_state(self) -> EdgeState:
-        st = super().init_state()
-        specs = self._state_specs(st)
-        return jax.tree.map(
-            lambda x, s: jax.device_put(x, NamedSharding(self.mesh, s)),
-            st, specs)
 
-    # -- drivers ---------------------------------------------------------
+class ShardedEngine(_ShardedDriver, JaxEngine):
+    """General (dynamic-destination) engine over a mesh: node axis
+    sharded, message exchange via destination-shard bucketing + one
+    ``lax.all_to_all`` per superstep (SURVEY.md §5.8's general-topology
+    delivery — the TPU-native replacement for the reference's per-peer
+    TCP sockets, `Transfer.hs:473,577`).
 
-    @partial(jax.jit, static_argnums=(0, 2))
-    def _run_scan(self, st: EdgeState, max_steps: int):
-        specs = self._state_specs(st)
+    Each device buckets its outgoing messages by destination shard
+    (stable, so sender-major order survives), the buckets swap in one
+    collective, and the received (src-shard-major, in-bucket) order
+    *is* global sender-major order — contract #3 for free. Bucket
+    capacity ``bucket_cap`` defaults to this device's total outbox
+    width (``n_local * max_out``), which cannot overflow — bit-for-bit
+    parity by construction; tune it down to shrink the exchange volume
+    (≤ the true per-shard fan-in) and any overflow is counted in
+    ``EngineState.overflow``, never silent.
+    """
 
-        def body(s):
-            def step(carry, _):
-                return self._superstep(carry, True)
-            return jax.lax.scan(step, s, None, length=max_steps)
+    def __init__(self, scenario: Scenario, link: LinkModel,
+                 mesh: Mesh, *, axis: str = "nodes", seed: int = 0,
+                 bucket_cap: Optional[int] = None) -> None:
+        super().__init__(scenario, link, seed=seed)
+        self.mesh = mesh
+        self.axis = axis
+        D = mesh.shape[axis]
+        self.comm = MeshComm(axis, scenario.n_nodes, D)
+        full = self.comm.n_local * scenario.max_out
+        self.bucket_cap = full if bucket_cap is None else min(
+            bucket_cap, full)
 
-        return jax.shard_map(
-            body, mesh=self.mesh, in_specs=(specs,),
-            out_specs=(specs, P()), check_vma=False)(st)
+    # -- the all_to_all exchange -----------------------------------------
 
-    @partial(jax.jit, static_argnums=(0,))
-    def _run_while(self, st: EdgeState, max_steps) -> EdgeState:
-        specs = self._state_specs(st)
-        max_steps = jnp.asarray(max_steps, jnp.int64)
-        from ...core.scenario import NEVER
-        from .common import I32MAX
+    def _exchange(self, ok, drel, src_f, dst_f, pay_f):
+        comm = self.comm
+        D, nl, B = comm.n_shards, comm.n_local, self.bucket_cap
+        S = ok.shape[0]
+        P = pay_f.shape[1]
+        # destination shard of each message; invalid -> sentinel D
+        dshard = jnp.where(ok, dst_f // jnp.int32(nl), jnp.int32(D))
+        perm = jnp.argsort(dshard, stable=True)   # sender-major per shard
+        sk = dshard[perm]
+        rank = jnp.arange(S, dtype=jnp.int32) - jnp.searchsorted(
+            sk, sk, side="left").astype(jnp.int32)
+        fits = (sk < D) & (rank < B)
+        brow = jnp.where(fits, sk, D)             # -> dropped scatter
+        bcol = jnp.clip(rank, 0, B - 1)
+        bucket_ovf = comm.all_sum(
+            jnp.sum((sk < D) & (rank >= B), dtype=jnp.int32))
 
-        def body_fn(s, ms):
-            start_steps = s.steps
+        def scat(x):
+            buf = jnp.zeros((D, B) + x.shape[1:], x.dtype)
+            return buf.at[brow, bcol].set(x[perm], mode="drop")
 
-            def cond(carry):
-                qmin = jnp.where(carry.q_valid, carry.q_rel, I32MAX).min()
-                has_q = qmin < I32MAX
-                nxt = self.comm.all_min(jnp.minimum(
-                    carry.wake.min(),
-                    jnp.where(has_q,
-                              carry.time + qmin.astype(jnp.int64),
-                              jnp.int64(NEVER))))
-                return (nxt < NEVER) & (carry.steps - start_steps < ms)
+        # only fitting entries scatter (brow==D drops the rest), so the
+        # occupancy mask is just "slot was written" — note `fits` is in
+        # *sorted* order already, so it must not go through scat's perm
+        b_ok = jnp.zeros((D, B), jnp.int8).at[brow, bcol].set(
+            jnp.int8(1), mode="drop")
+        b_drel = scat(drel)
+        b_src = scat(src_f)
+        b_dst = scat(dst_f)
+        b_pay = scat(pay_f)
 
-            def body(carry):
-                return self._superstep(carry, False)[0]
+        def a2a(x):
+            return jax.lax.all_to_all(
+                x, self.axis, split_axis=0, concat_axis=0)
 
-            return jax.lax.while_loop(cond, body, s)
+        r_ok = a2a(b_ok).reshape(D * B).astype(bool)
+        r_drel = a2a(b_drel).reshape(D * B)
+        r_src = a2a(b_src).reshape(D * B)
+        r_dst = a2a(b_dst).reshape(D * B)
+        r_pay = a2a(b_pay).reshape(D * B, P)
+        # received rows are local: subtract this shard's node offset
+        off = jax.lax.axis_index(self.axis).astype(jnp.int32) \
+            * jnp.int32(nl)
+        return r_ok, r_drel, r_src, r_dst - off, r_pay, bucket_ovf
 
-        return jax.shard_map(
-            body_fn, mesh=self.mesh, in_specs=(specs, P()),
-            out_specs=specs, check_vma=False)(st, max_steps)
+    # -- sharding specs --------------------------------------------------
+
+    def _state_specs(self, st: EngineState) -> EngineState:
+        ax = self.axis
+
+        def leaf(x):
+            nd = getattr(x, "ndim", 0)
+            if nd == 0:
+                return P()
+            return P(ax, *([None] * (nd - 1)))
+
+        return EngineState(
+            states=jax.tree.map(leaf, st.states),
+            wake=P(ax), mb_rel=leaf(st.mb_rel), mb_src=leaf(st.mb_src),
+            mb_payload=leaf(st.mb_payload), mb_valid=leaf(st.mb_valid),
+            overflow=P(), bad_dst=P(), bad_delay=P(),
+            delivered=P(), steps=P(), time=P(),
+        )

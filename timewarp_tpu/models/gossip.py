@@ -1,0 +1,123 @@
+"""Gossip broadcast — BASELINE.json config 4 ("gossip broadcast, 100k
+nodes, lognormal latency model").
+
+A push-rumor epidemic: node 0 originates a rumor; every node, on first
+hearing it, relays it to ``fanout`` pseudo-random peers, one send per
+``gossip_interval`` after a ``think_us`` incubation. The scenario the
+reference *could* have written against its `Delays`-style emulated
+network (examples/token-ring/Main.hs:73-77 is the same shape: a seeded
+per-link latency draw on every message) but never shipped.
+
+Destinations are dynamic — drawn from an in-state LCG per send — so
+this runs on the general engine (`interp/jax_engine/engine.py`), and
+sharded on the all_to_all :class:`ShardedEngine`. The inbox reduces
+commutatively (min over hop counts), so no contract-#2 sort is
+compiled in.
+
+Payload layout: ``[hop, 0]`` — the relay depth at which the rumor
+travels; receivers adopt the minimum incoming hop.
+"""
+
+from __future__ import annotations
+
+from ..utils import jaxconfig  # noqa: F401
+
+import jax.numpy as jnp
+
+from ..core.scenario import NEVER, Inbox, Outbox, Scenario
+from ..core.time import Microsecond, ms, sec
+from ..net.delays import LinkModel, LogNormalDelay
+
+__all__ = ["gossip", "gossip_links"]
+
+_LCG_A = 1103515245
+_LCG_C = 12345
+
+
+def gossip(n: int, *,
+           fanout: int = 8,
+           think_us: Microsecond = ms(5),
+           gossip_interval: Microsecond = ms(2),
+           bootstrap_us: Microsecond = ms(1),
+           end_us: Microsecond = sec(60),
+           mailbox_cap: int = 16) -> Scenario:
+    """Build the gossip scenario. Node 0 starts infected; the run
+    quiesces when every node has relayed its ``fanout`` sends (or the
+    ``end_us`` deadline passes)."""
+
+    def step(state, inbox: Inbox, now, i, key):
+        hop, lcg = state["hop"], state["lcg"]
+        left, nxt = state["left"], state["next"]
+
+        # adopt the minimum incoming relay depth (commutative)
+        hin = jnp.min(jnp.where(inbox.valid, inbox.payload[:, 0],
+                                jnp.int32(2**31 - 1)))
+        got_new = (hop < 0) & (hin < 2**31 - 1)
+        hop1 = jnp.where(got_new, hin, hop)
+        alive = now < jnp.int64(end_us)
+        # first infection: arm the relay burst after the incubation
+        left1 = jnp.where(got_new & alive, jnp.int32(fanout), left)
+        nxt1 = jnp.where(got_new & alive, now + jnp.int64(think_us), nxt)
+
+        # one relay send per firing of the relay timer
+        due = (left1 > 0) & (nxt1 <= now) & alive
+        lcg1 = jnp.where(due, lcg * jnp.int32(_LCG_A) + jnp.int32(_LCG_C),
+                         lcg)
+        # peer in [0, n) excluding self
+        dst = (i + jnp.int32(1)
+               + (jnp.abs(lcg1) % jnp.int32(n - 1))) % jnp.int32(n)
+        out = Outbox(
+            valid=due[None],
+            dst=dst[None],
+            payload=jnp.stack([hop1 + 1, jnp.int32(0)])[None])
+        left2 = left1 - due.astype(jnp.int32)
+        nxt2 = jnp.where(due,
+                         jnp.where(left2 > 0,
+                                   now + jnp.int64(gossip_interval),
+                                   jnp.int64(NEVER)),
+                         nxt1)
+        wake = jnp.where((left2 > 0) & alive, nxt2, jnp.int64(NEVER))
+        return {"hop": hop1, "lcg": lcg1, "left": left2,
+                "next": nxt2}, out, wake
+
+    def init(i: int):
+        seeded = i == 0
+        return {
+            "hop": jnp.int32(0 if seeded else -1),
+            "lcg": jnp.int32((i * 2654435761) % (2**31 - 1) + 1),
+            "left": jnp.int32(fanout if seeded else 0),
+            "next": jnp.int64(bootstrap_us if seeded else NEVER),
+        }, bootstrap_us if seeded else NEVER
+
+    def init_batched(nn: int):
+        ids = jnp.arange(nn, dtype=jnp.int32)
+        seeded = ids == 0
+        wake = jnp.where(seeded, jnp.int64(bootstrap_us),
+                         jnp.int64(NEVER))
+        states = {
+            "hop": jnp.where(seeded, 0, -1).astype(jnp.int32),
+            "lcg": ((ids.astype(jnp.int64) * 2654435761)
+                    % (2**31 - 1) + 1).astype(jnp.int32),
+            "left": jnp.where(seeded, fanout, 0).astype(jnp.int32),
+            "next": wake,
+        }
+        return states, wake
+
+    return Scenario(
+        name=f"gossip-{n}",
+        n_nodes=n,
+        step=step,
+        init=init,
+        init_batched=init_batched,
+        payload_width=2,
+        max_out=1,
+        mailbox_cap=mailbox_cap,
+        commutative_inbox=True,
+        meta={"fanout": fanout, "end_us": end_us},
+    )
+
+
+def gossip_links(*, median_us: int = ms(50), sigma: float = 0.6,
+                 cap_us: int = sec(10)) -> LinkModel:
+    """The baseline config's lognormal latency model (net/delays.py)."""
+    return LogNormalDelay(median_us, sigma, cap_us)

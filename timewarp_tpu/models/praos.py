@@ -1,0 +1,136 @@
+"""Ouroboros-Praos slot-leader consensus — BASELINE.json config 5
+("Ouroboros-Praos slot-leader consensus, 1M stake nodes").
+
+The abstract shape of Praos (the protocol the reference library was
+built to serve at IOHK): time is divided into fixed slots; in every
+slot each stake node independently wins slot leadership with
+probability ``f`` from a private VRF draw; a leader extends its
+current best chain by one block and diffuses the new tip; nodes adopt
+the longest tip they hear and relay it onward. Chain growth and fork
+resolution emerge from message latency vs slot length.
+
+TPU mapping: leadership is the per-(node, slot-instant) counter-based
+entropy the engines already derive (``fire_bits``; the scenario
+declares ``needs_key``) — an integer threshold compare, bit-exact on
+every backend. Tips diffuse to ``fanout`` pseudo-random peers per
+adoption (dynamic destinations → general engine; sharded all_to_all).
+The inbox reduces commutatively (max over tip length).
+
+Payload layout: ``[chain_len, relayer]`` — slot 1 carries the id of
+the node that *relayed* this tip (re-stamped at every hop), not the
+block's original minter.
+"""
+
+from __future__ import annotations
+
+from ..utils import jaxconfig  # noqa: F401
+
+import jax.numpy as jnp
+
+from ..core.scenario import NEVER, Inbox, Outbox, Scenario
+from ..core.time import Microsecond, ms, sec
+
+__all__ = ["praos"]
+
+_LCG_A = 1103515245
+_LCG_C = 12345
+
+
+def praos(n: int, *,
+          slot_us: Microsecond = sec(1),
+          n_slots: int = 20,
+          leader_prob: float = 0.05,
+          fanout: int = 8,
+          relay_interval: Microsecond = ms(2),
+          mailbox_cap: int = 16) -> Scenario:
+    """Build the Praos scenario. Quiesces after ``n_slots`` slots once
+    the last relay bursts drain. ``leader_prob`` is the per-slot
+    per-node leadership probability (the aggregate block rate is
+    ``n * leader_prob`` per slot — keep it ≲ a few for realistic
+    fork behavior at scale)."""
+    thr = min(int(leader_prob * 4294967296.0), 2**32 - 1)
+
+    def step(state, inbox: Inbox, now, i, key):
+        best, lcg = state["best"], state["lcg"]
+        left, nrelay = state["left"], state["nrelay"]
+        slot, nslot = state["slot"], state["nslot"]
+
+        # adopt the longest incoming tip (commutative max)
+        tin = jnp.max(jnp.where(inbox.valid, inbox.payload[:, 0],
+                                jnp.int32(-1)))
+        adopt = tin > best
+        best1 = jnp.where(adopt, tin, best)
+
+        # slot boundary: private leadership draw from the firing entropy
+        due_slot = (slot < jnp.int32(n_slots)) & (nslot <= now)
+        b0, _ = key
+        leader = due_slot & (b0 < jnp.uint32(thr))
+        best2 = best1 + leader.astype(jnp.int32)
+        slot1 = slot + due_slot.astype(jnp.int32)
+        nslot1 = jnp.where(due_slot, nslot + jnp.int64(slot_us), nslot)
+
+        # a new tip (adopted or minted) re-arms the relay burst
+        fresh = adopt | leader
+        left1 = jnp.where(fresh, jnp.int32(fanout), left)
+        nrelay1 = jnp.where(fresh, now + jnp.int64(relay_interval), nrelay)
+
+        # one relay send per firing of the relay timer
+        due_relay = (left1 > 0) & (nrelay1 <= now)
+        lcg1 = jnp.where(due_relay,
+                         lcg * jnp.int32(_LCG_A) + jnp.int32(_LCG_C), lcg)
+        dst = (i + jnp.int32(1)
+               + (jnp.abs(lcg1) % jnp.int32(n - 1))) % jnp.int32(n)
+        out = Outbox(
+            valid=due_relay[None],
+            dst=dst[None],
+            payload=jnp.stack([best2, i])[None])
+        left2 = left1 - due_relay.astype(jnp.int32)
+        nrelay2 = jnp.where(due_relay,
+                            now + jnp.int64(relay_interval), nrelay1)
+
+        slot_wake = jnp.where(slot1 < jnp.int32(n_slots), nslot1,
+                              jnp.int64(NEVER))
+        relay_wake = jnp.where(left2 > 0, nrelay2, jnp.int64(NEVER))
+        wake = jnp.minimum(slot_wake, relay_wake)
+        return {"best": best2, "lcg": lcg1, "left": left2,
+                "nrelay": nrelay2, "slot": slot1,
+                "nslot": nslot1}, out, wake
+
+    def init(i: int):
+        return {
+            "best": jnp.int32(0),
+            "lcg": jnp.int32((i * 2654435761) % (2**31 - 1) + 1),
+            "left": jnp.int32(0),
+            "nrelay": jnp.int64(NEVER),
+            "slot": jnp.int32(0),
+            "nslot": jnp.int64(slot_us),
+        }, slot_us
+
+    def init_batched(nn: int):
+        ids = jnp.arange(nn, dtype=jnp.int32)
+        wake = jnp.full(nn, slot_us, jnp.int64)
+        states = {
+            "best": jnp.zeros(nn, jnp.int32),
+            "lcg": ((ids.astype(jnp.int64) * 2654435761)
+                    % (2**31 - 1) + 1).astype(jnp.int32),
+            "left": jnp.zeros(nn, jnp.int32),
+            "nrelay": jnp.full(nn, NEVER, jnp.int64),
+            "slot": jnp.zeros(nn, jnp.int32),
+            "nslot": jnp.full(nn, slot_us, jnp.int64),
+        }
+        return states, wake
+
+    return Scenario(
+        name=f"praos-{n}",
+        n_nodes=n,
+        step=step,
+        init=init,
+        init_batched=init_batched,
+        payload_width=2,
+        max_out=1,
+        mailbox_cap=mailbox_cap,
+        needs_key=True,
+        commutative_inbox=True,
+        meta={"slot_us": slot_us, "n_slots": n_slots,
+              "leader_prob": leader_prob, "fanout": fanout},
+    )
