@@ -1,20 +1,25 @@
-"""Headline benchmark: delivered-messages/sec/chip on the dense token ring.
-
-The flagship workload is the reference's north-star scenario
-(`/root/reference/examples/token-ring/Main.hs`) generalized to a dense
-ring — every node holds a token, so each superstep fires all N nodes and
-delivers N messages — at the BASELINE.json target scale (1M simulated
-nodes, delivered-messages/sec/chip, target >= 1e8).
-
-Runs on the edge engine (interp/jax_engine/edge_engine.py): the ring's
-static topology makes delivery a fused neighbor shift — no sort, no
-scatter (profiling/superstep_breakdown.md).
+"""Benchmark driver: delivered-messages/sec/chip across the baseline
+workloads (BASELINE.json configs; targets in BASELINE.md).
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 ``vs_baseline`` is value / 1e8 (the north-star target; the reference
 itself publishes no numbers — BASELINE.md).
 
-Env knobs: TW_BENCH_NODES (default 1048576), TW_BENCH_STEPS (default 256).
+Configs (select with TW_BENCH_CONFIG, default ``token_ring_dense``):
+
+- ``token_ring_dense`` — the headline: dense token ring on the
+  static-topology edge engine (pure neighbor shift, no sort/scatter);
+  the reference's north-star scenario at 1M nodes.
+- ``token_ring_observer`` — the reference's *actual* token-ring shape
+  (observer hub, dynamic destinations) on the general engine.
+- ``gossip_100k`` — push-rumor broadcast, 100k nodes, lognormal
+  latency quantized to a 1 ms grid (net/delays.py ``Quantize``:
+  time-bucketed batching) on the general engine.
+- ``praos_1m`` — Ouroboros-Praos slot-leader consensus at 1M stake
+  nodes, general engine, quantized lognormal links.
+
+Env knobs: TW_BENCH_CONFIG, TW_BENCH_NODES (config-default), and
+TW_BENCH_STEPS (supersteps in the measured window).
 """
 
 import json
@@ -25,38 +30,127 @@ from timewarp_tpu.utils import jaxconfig  # noqa: F401
 
 import jax
 
-from timewarp_tpu.interp.jax_engine.edge_engine import EdgeEngine
-from timewarp_tpu.models.token_ring import token_ring
-from timewarp_tpu.net.delays import FixedDelay
 
-
-def main() -> None:
-    n = int(os.environ.get("TW_BENCH_NODES", 1 << 20))
-    steps = int(os.environ.get("TW_BENCH_STEPS", 256))
-
-    # Dense ring, think_us=0: a node receiving a token forwards it in
-    # the same firing, so every superstep delivers exactly N messages.
-    # end_us far enough that the deadline never quiesces the run.
-    sc = token_ring(
-        n, n_tokens=n, think_us=0, bootstrap_us=1_000,
-        end_us=(1 << 50), with_observer=False, mailbox_cap=4)
-    engine = EdgeEngine(sc, FixedDelay(500), cap=2)
-
+def _measure(engine, steps, warm_steps=2):
     st = engine.init_state()
     st = jax.block_until_ready(st)
-
     # Warmup: compile the while_loop driver (first TPU compile 20-40 s).
-    warm = engine.run_quiet(2, st)
+    warm = engine.run_quiet(warm_steps, st)
     int(warm.delivered)  # force completion via host readback
-
     t0 = time.perf_counter()
     fin = engine.run_quiet(steps, warm)
     delivered = int(fin.delivered) - int(warm.delivered)  # forces readback
     dt = time.perf_counter() - t0
+    return delivered, dt, fin
 
-    rate = delivered / dt
+
+def bench_token_ring_dense(n, steps):
+    """Dense ring, think_us=0: a node receiving a token forwards it in
+    the same firing, so every superstep delivers exactly N messages.
+    end_us far enough that the deadline never quiesces the run."""
+    from timewarp_tpu.interp.jax_engine.edge_engine import EdgeEngine
+    from timewarp_tpu.models.token_ring import token_ring
+    from timewarp_tpu.net.delays import FixedDelay
+
+    n = n or 1 << 20
+    sc = token_ring(
+        n, n_tokens=n, think_us=0, bootstrap_us=1_000,
+        end_us=(1 << 50), with_observer=False, mailbox_cap=4)
+    engine = EdgeEngine(sc, FixedDelay(500), cap=2)
+    delivered, dt, _ = _measure(engine, steps or 256)
+    return (f"token-ring dense delivered-messages/sec/chip @{n} nodes",
+            delivered / dt)
+
+
+def bench_token_ring_observer(n, steps):
+    """The reference example's real shape (examples/token-ring/Main.hs:
+    104-208): every token hop also notifies an observer hub —
+    dynamic destinations, general engine. Dense-token regime with
+    think quantized so rings fire co-temporally."""
+    from timewarp_tpu.interp.jax_engine.engine import JaxEngine
+    from timewarp_tpu.models.token_ring import token_ring
+    from timewarp_tpu.net.delays import FixedDelay
+
+    n = n or (1 << 16)  # ring nodes; +1 observer
+    sc = token_ring(
+        n, n_tokens=n, think_us=1_000, bootstrap_us=1_000,
+        end_us=(1 << 50), with_observer=True,
+        mailbox_cap=8)
+    engine = JaxEngine(sc, FixedDelay(500))
+    delivered, dt, _ = _measure(engine, steps or 128)
+    return (f"token-ring observer (general engine) "
+            f"delivered-messages/sec/chip @{n} nodes", delivered / dt)
+
+
+def bench_gossip_100k(n, steps):
+    from timewarp_tpu.interp.jax_engine.engine import JaxEngine
+    from timewarp_tpu.models.gossip import gossip, gossip_links
+    from timewarp_tpu.net.delays import Quantize
+
+    n = n or 100_000
+    sc = gossip(n, fanout=8, think_us=2_000, gossip_interval=1_000,
+                end_us=(1 << 50), mailbox_cap=16)
+    link = Quantize(gossip_links(median_us=20_000, sigma=0.6), 1_000)
+    engine = JaxEngine(sc, link)
+    delivered, dt, _ = _measure(engine, steps or 512, warm_steps=16)
+    return (f"gossip broadcast (lognormal links) "
+            f"delivered-messages/sec/chip @{n} nodes", delivered / dt)
+
+
+def bench_gossip_steady_1m(n, steps):
+    """Rumor-mongering steady state: every infected node relays to one
+    pseudo-random peer per 1 ms round — the dense dynamic-destination
+    regime of the general engine (1M messages per superstep at 1M
+    nodes, every one through the all-destination routing path)."""
+    from timewarp_tpu.interp.jax_engine.engine import JaxEngine
+    from timewarp_tpu.models.gossip import gossip
+    from timewarp_tpu.net.delays import Quantize, UniformDelay
+
+    n = n or 1 << 20
+    sc = gossip(n, fanout=1, think_us=1_000, gossip_interval=1_000,
+                end_us=(1 << 50), steady=True, mailbox_cap=8)
+    link = Quantize(UniformDelay(500, 4_500), 1_000)
+    engine = JaxEngine(sc, link)
+    # warm through the infection ramp-up so the measured window is the
+    # steady state (seed node infects ~2^k nodes by round k)
+    delivered, dt, _ = _measure(engine, steps or 128, warm_steps=64)
+    return (f"gossip steady-state (rumor mongering) "
+            f"delivered-messages/sec/chip @{n} nodes", delivered / dt)
+
+
+def bench_praos_1m(n, steps):
+    from timewarp_tpu.interp.jax_engine.engine import JaxEngine
+    from timewarp_tpu.models.praos import praos
+    from timewarp_tpu.net.delays import LogNormalDelay, Quantize
+
+    n = n or 1 << 20
+    sc = praos(n, slot_us=1_000_000, n_slots=1 << 30,
+               leader_prob=4.0 / n, fanout=8, relay_interval=1_000,
+               mailbox_cap=16)
+    link = Quantize(LogNormalDelay(20_000, 0.6), 1_000)
+    engine = JaxEngine(sc, link)
+    delivered, dt, _ = _measure(engine, steps or 256, warm_steps=16)
+    return (f"praos slot-leader consensus "
+            f"delivered-messages/sec/chip @{n} stake nodes",
+            delivered / dt)
+
+
+CONFIGS = {
+    "token_ring_dense": bench_token_ring_dense,
+    "token_ring_observer": bench_token_ring_observer,
+    "gossip_100k": bench_gossip_100k,
+    "gossip_steady_1m": bench_gossip_steady_1m,
+    "praos_1m": bench_praos_1m,
+}
+
+
+def main() -> None:
+    cfg = os.environ.get("TW_BENCH_CONFIG", "token_ring_dense")
+    n = int(os.environ.get("TW_BENCH_NODES", 0)) or None
+    steps = int(os.environ.get("TW_BENCH_STEPS", 0)) or None
+    metric, rate = CONFIGS[cfg](n, steps)
     print(json.dumps({
-        "metric": f"token-ring dense delivered-messages/sec/chip @{n} nodes",
+        "metric": metric,
         "value": round(rate, 1),
         "unit": "msg/s",
         "vs_baseline": round(rate / 1e8, 4),

@@ -93,3 +93,64 @@ def test_sharded_general_run_quiet_matches_traced():
         assert np.array_equal(
             np.asarray(jax.device_get(traced_final.states[k])),
             np.asarray(jax.device_get(quiet_final.states[k]))), k
+
+
+def test_gossip_steady_mode_parity():
+    """Rumor-mongering variant: relays never exhaust; parity vs oracle
+    and the 8-device all_to_all engine, then quiesces at the deadline."""
+    from timewarp_tpu.net.delays import Quantize
+
+    sc = gossip(48, fanout=1, think_us=1_000, gossip_interval=1_000,
+                end_us=40_000, steady=True, mailbox_cap=8)
+    link = Quantize(UniformDelay(500, 2_500), 1_000)
+    fst, lt = three_way(sc, link, 300)
+    hops = np.asarray(jax.device_get(fst.states["hop"]))
+    assert (hops >= 0).all()
+    # steady state reached: far more deliveries than fanout-bounded
+    assert lt.total_delivered() > 300
+    # the deadline actually quiesces the run
+    assert len(lt) < 300
+
+
+def test_general_engine_overflow_parity_with_oracle():
+    """Contract #6 under load: when mailboxes overflow, the general
+    engine must drop exactly the messages the oracle drops — overflow
+    counts AND the surviving trace stay bit-for-bit equal (VERDICT r2
+    item 7)."""
+    import jax.numpy as jnp
+    from timewarp_tpu.core.scenario import NEVER, Outbox, Scenario
+    from timewarp_tpu.net.delays import FixedDelay
+
+    n = 8
+
+    def step(state, inbox, now, i, key):
+        got = jnp.sum(inbox.valid, dtype=jnp.int32)
+        alive = now < 20_000
+        is_sender = i > 0
+        out = Outbox(valid=(is_sender & alive)[None],
+                     dst=jnp.int32(0)[None],
+                     payload=jnp.stack(
+                         [state["sent"] + 1, jnp.int32(0)])[None])
+        wake = jnp.where(is_sender & alive, now + 500,
+                         jnp.where(now < 40_000, now + 7_000,
+                                   jnp.int64(NEVER)))
+        return {"seen": state["seen"] + got,
+                "sent": state["sent"] + 1}, out, wake
+
+    def init(i):
+        return {"seen": jnp.int32(0), "sent": jnp.int32(0)}, \
+            0 if i > 0 else 7_000
+
+    # 7 senders × 1 msg / 500 µs into node 0, which only fires (and
+    # drains) every 7 ms with mailbox_cap=4: heavy overflow
+    sc = Scenario(name="overflow-hub", n_nodes=n, step=step, init=init,
+                  payload_width=2, max_out=1, mailbox_cap=4,
+                  commutative_inbox=True)
+    link = FixedDelay(1_000)
+    ot = SuperstepOracle(sc, link).run(3000)
+    fst, lt = JaxEngine(sc, link).run(300)
+    assert_traces_equal(ot, lt, "oracle", "engine", limit=len(lt))
+    assert int(fst.overflow) > 0          # the test actually overflowed
+    sst, st = ShardedEngine(sc, link, make_mesh(8)).run(300)
+    assert_traces_equal(ot, st, "oracle", "sharded", limit=len(st))
+    assert int(sst.overflow) == int(fst.overflow)

@@ -43,12 +43,12 @@ import jax.numpy as jnp
 import numpy as np
 
 from ...core.rng import fire_bits, msg_bits, seed_words
-from ...core.scenario import NEVER, Inbox, Scenario
+from ...core.scenario import NEVER, Inbox, Outbox, Scenario
 from ...net.delays import LinkModel
 from ...trace.events import SuperstepTrace
 from ...trace.hashing import FIRED, RECV, SENT, mix32_jnp
 from .common import I32MAX as _I32MAX
-from .common import LocalComm, StepOut as _StepOut
+from .common import LocalComm, StepOut as _StepOut, group_rank
 from .common import thi as _thi, tlo as _tlo, u32sum as _u32sum
 
 __all__ = ["JaxEngine", "EngineState"]
@@ -58,16 +58,19 @@ class EngineState(NamedTuple):
     """The complete simulation state — one pytree, trivially
     checkpointable (SURVEY.md §5.4) and shardable over a mesh.
 
-    Mailbox deliver-times are int32 µs relative to ``time`` (the epoch
-    is rebased every superstep); delays ≥ 2^31 µs are clamped and
-    counted in ``bad_delay``.
+    Mailbox layout is ``[K, N]`` (minor dim = node axis — no lane
+    padding, perfect VPU tiling; the [N, K] layout taxes every
+    materialized intermediate ~128/K in memory traffic,
+    profiling/superstep_breakdown.md). Deliver-times are int32 µs
+    relative to ``time`` (the epoch is rebased every superstep); delays
+    ≥ 2^31 µs are clamped and counted in ``bad_delay``.
     """
     states: Any        # scenario pytree, leading dim N
     wake: jax.Array    # int64[N]
-    mb_rel: jax.Array      # int32[N, K] — deliver time minus `time`
-    mb_src: jax.Array      # int32[N, K]
-    mb_payload: jax.Array  # int32[N, K, P]
-    mb_valid: jax.Array    # bool[N, K]
+    mb_rel: jax.Array      # int32[K, N] — deliver time minus `time`
+    mb_src: jax.Array      # int32[K, N]
+    mb_payload: jax.Array  # int32[K, P, N]
+    mb_valid: jax.Array    # bool[K, N]
     overflow: jax.Array    # int32[] — total overflowed messages
     bad_dst: jax.Array     # int32[] — total messages to invalid destinations
     bad_delay: jax.Array   # int32[] — delays >= 2^31 µs, clamped
@@ -88,6 +91,9 @@ class JaxEngine:
 
     def __init__(self, scenario: Scenario, link: LinkModel, *,
                  seed: int = 0) -> None:
+        if scenario.n_nodes * scenario.max_out >= 2**31:
+            raise ValueError(
+                "n_nodes * max_out must fit int32 (sender-major rank)")
         self.scenario = scenario
         self.link = link
         self.s0, self.s1 = seed_words(seed)
@@ -110,10 +116,10 @@ class JaxEngine:
         return EngineState(
             states=states,
             wake=wake,
-            mb_rel=jnp.full((n, K), _I32MAX, jnp.int32),
-            mb_src=jnp.zeros((n, K), jnp.int32),
-            mb_payload=jnp.zeros((n, K, P), jnp.int32),
-            mb_valid=jnp.zeros((n, K), bool),
+            mb_rel=jnp.full((K, n), _I32MAX, jnp.int32),
+            mb_src=jnp.zeros((K, n), jnp.int32),
+            mb_payload=jnp.zeros((K, P, n), jnp.int32),
+            mb_valid=jnp.zeros((K, n), bool),
             overflow=jnp.int32(0),
             bad_dst=jnp.int32(0),
             bad_delay=jnp.int32(0),
@@ -124,16 +130,19 @@ class JaxEngine:
 
     # -- one superstep ---------------------------------------------------
 
-    def _exchange(self, ok, drel, src_f, dst_f, pay_f):
+    def _exchange(self, ok, drel, src_f, dst_f, smrank, pay_cols):
         """Hand routed messages to the device that owns their
-        destination, returning ``(ok, drel, src, local_row, payload,
-        bucket_overflow)`` for the messages *this* device's nodes will
-        receive. Single chip: identity — the global destination id is
-        the local mailbox row. The sharded engine (sharded.py)
-        overrides this with destination-shard bucketing + one
-        ``lax.all_to_all``; bucket overflow is counted, never silent.
-        ``dst_f`` is the global destination, already validated."""
-        return ok, drel, src_f, dst_f, pay_f, jnp.int32(0)
+        destination, returning ``(ok, drel, src, local_row, smrank,
+        pay_cols, bucket_overflow)`` for the messages *this* device's
+        nodes will receive. Single chip: identity — the global
+        destination id is the local mailbox row. The sharded engine
+        (sharded.py) overrides this with destination-shard bucketing +
+        one ``lax.all_to_all``; bucket overflow is counted, never
+        silent. ``dst_f`` is the global destination, already validated;
+        ``smrank`` is the message's global sender-major rank
+        (``src * max_out + slot``) — insertion sorts on it, so exchange
+        order never matters."""
+        return ok, drel, src_f, dst_f, smrank, pay_cols, jnp.int32(0)
 
     def _superstep(self, st: EngineState, with_trace: bool
                    ) -> Tuple[EngineState, Optional[_StepOut]]:
@@ -145,8 +154,8 @@ class JaxEngine:
         base = st.time
 
         # 1. global next event time (the batched "pop min", TimedT.hs:241-245)
-        mb_eff = jnp.where(st.mb_valid, st.mb_rel, _I32MAX)
-        nnr = mb_eff.min(axis=1)
+        mb_eff = jnp.where(st.mb_valid, st.mb_rel, _I32MAX)     # [K, N]
+        nnr = mb_eff.min(axis=0)
         node_next = jnp.minimum(
             st.wake,
             jnp.where(nnr == _I32MAX, jnp.int64(NEVER),
@@ -158,18 +167,19 @@ class JaxEngine:
                               jnp.int64(_I32MAX - 1)).astype(jnp.int32)
 
         # 2. deliverable messages, per firing node
-        deliver = st.mb_valid & (st.mb_rel <= shift32) & fire[:, None]
+        deliver = st.mb_valid & (st.mb_rel <= shift32) & fire[None, :]
 
         # 3. inbox: delivered slots first, ordered by (time, arrival slot)
-        #    (determinism contract #2) — one variadic sort per row
-        slots = jnp.broadcast_to(jnp.arange(K, dtype=jnp.int32), (n, K))
+        #    (determinism contract #2) — one variadic sort along K
+        slots = jnp.broadcast_to(
+            jnp.arange(K, dtype=jnp.int32)[:, None], (K, n))
         rel_key = jnp.where(deliver, st.mb_rel, _I32MAX)
         ops = jax.lax.sort(
             (~deliver, rel_key, slots, st.mb_src) + tuple(
-                st.mb_payload[:, :, p] for p in range(P)),
-            dimension=1, num_keys=3)
+                st.mb_payload[:, p, :] for p in range(P)),
+            dimension=0, num_keys=3)
         ib_valid, ib_rel, ib_src = ~ops[0], ops[1], ops[3]
-        ib_pay = jnp.stack(ops[4:4 + P], axis=2)
+        ib_pay = jnp.stack(ops[4:4 + P], axis=1)                # [K, P, N]
         # pad invalid slots exactly like the oracle (src=0, time=NEVER,
         # payload=0) so an unmasked read in a user step function cannot
         # diverge between interpreters
@@ -178,15 +188,19 @@ class JaxEngine:
             src=jnp.where(ib_valid, ib_src, 0),
             time=jnp.where(ib_valid, base + ib_rel.astype(jnp.int64),
                            jnp.int64(NEVER)),
-            payload=jnp.where(ib_valid[:, :, None], ib_pay, 0),
+            payload=jnp.where(ib_valid[:, None, :], ib_pay, 0),
         )
 
         # 4. fire every node simultaneously; mask non-fired results.
         # Entropy is derived elementwise (core/rng.py) — no key arrays.
+        # Batch axis is the *minor* dim for inbox and outbox leaves.
         bits = fire_bits(self.s0, self.s1, node_ids, t) \
             if sc.needs_key else None
         new_states, out, new_wake = jax.vmap(
-            sc.step, in_axes=(0, 0, None, 0, None if bits is None else 0))(
+            sc.step,
+            in_axes=(0, Inbox(valid=-1, src=-1, time=-1, payload=-1),
+                     None, 0, None if bits is None else 0),
+            out_axes=(0, Outbox(valid=-1, dst=-1, payload=-1), 0))(
                 st.states, inbox, t, node_ids, bits)
         states = jax.tree.map(
             lambda a, b: jnp.where(
@@ -195,27 +209,29 @@ class JaxEngine:
         new_wake = jnp.where(new_wake >= NEVER, NEVER,
                              jnp.maximum(new_wake, t + 1))  # contract #5
         wake = jnp.where(fire, new_wake, st.wake)
-        out_valid = out.valid & fire[:, None]
+        out_valid = out.valid & fire[None, :]                   # [M, N]
 
         # 5. compact mailboxes: drop delivered, keep arrival order,
         #    rebase surviving deliver-times to the new epoch t
         keep = st.mb_valid & ~deliver
         ops2 = jax.lax.sort(
             (~keep, slots, st.mb_rel, st.mb_src) + tuple(
-                st.mb_payload[:, :, p] for p in range(P)),
-            dimension=1, num_keys=2)
+                st.mb_payload[:, p, :] for p in range(P)),
+            dimension=0, num_keys=2)
         mb_valid = ~ops2[0]
         mb_rel = jnp.where(mb_valid, ops2[2] - shift32, _I32MAX)
         mb_src = ops2[3]
-        mb_payload = jnp.stack(ops2[4:4 + P], axis=2)
-        counts = mb_valid.sum(axis=1, dtype=jnp.int32)
+        mb_payload = jnp.stack(ops2[4:4 + P], axis=1)
+        counts = mb_valid.sum(axis=0, dtype=jnp.int32)          # [N]
 
-        # 6. route outboxes in sender-major order (contract #3)
+        # 6. route outboxes; arrival order is fixed later by the global
+        #    sender-major rank key, so the flatten order is free
+        #    (slot-major — no transpose of the [M, N] outbox)
         S = n * M
-        src_f = jnp.repeat(node_ids, M)
-        slot_f = jnp.tile(jnp.arange(M, dtype=jnp.int32), n)
+        src_f = jnp.tile(node_ids, M)
+        slot_f = jnp.repeat(jnp.arange(M, dtype=jnp.int32), n)
         dst_f = out.dst.reshape(S).astype(jnp.int32)
-        pay_f = out.payload.reshape(S, P)
+        pay_cols = tuple(out.payload[:, p, :].reshape(S) for p in range(P))
         v_f = out_valid.reshape(S)
         mbits = msg_bits(self.s0, self.s1, src_f, dst_f, t, slot_f) \
             if self.link.needs_key else None
@@ -230,31 +246,34 @@ class JaxEngine:
         bad_delay_step = comm.all_sum(jnp.sum(
             ok & (drel64 > jnp.int64(_I32MAX - 1)), dtype=jnp.int32))
         drel = jnp.minimum(drel64, jnp.int64(_I32MAX - 1)).astype(jnp.int32)
+        # global sender-major rank — contract #3's arrival order as a
+        # sortable value (init guards n_glob * M < 2^31)
+        smrank = src_f * jnp.int32(M) + slot_f
 
         # 6.5. hand each message to the device that owns its destination
         # (identity single-chip; bucket + all_to_all sharded) — rows come
         # back device-local
-        ok_r, drel_r, src_r, row_r, pay_r, bucket_ovf = self._exchange(
-            ok, drel, src_f, dst_f, pay_f)
-        S_r = ok_r.shape[0]
+        ok_r, drel_r, src_r, row_r, smrank_r, pay_r, bucket_ovf = \
+            self._exchange(ok, drel, src_f, dst_f, smrank, pay_cols)
 
-        # 7. insert: stable sort by destination; rank within destination
-        #    = sender-major arrival order; bounded by mailbox capacity
+        # 7. insert: ONE variadic sort by (destination, sender-major
+        #    rank) — values ride along, replacing the argsort + gather
+        #    chain (gathers cost ~1 ms/131k on TPU; sort is ~free)
         sort_dst = jnp.where(ok_r, row_r, n)  # invalid -> sentinel row n
-        perm3 = jnp.argsort(sort_dst, stable=True)
-        sd = sort_dst[perm3]
-        rank = jnp.arange(S_r, dtype=jnp.int32) - jnp.searchsorted(
-            sd, sd, side="left").astype(jnp.int32)
-        base_cnt = counts[jnp.clip(sd, 0, n - 1)]
-        pos = base_cnt + rank
-        ok_s = ok_r[perm3]
+        ops3 = jax.lax.sort(
+            (sort_dst, smrank_r, ok_r, drel_r, src_r) + pay_r,
+            dimension=0, num_keys=2)
+        sd, ok_s, drel_s, src_s = ops3[0], ops3[2], ops3[3], ops3[4]
+        pos = counts[jnp.clip(sd, 0, n - 1)] + group_rank(sd)
         fits = ok_s & (pos < K)
         row = jnp.where(fits, sd, n)  # out-of-range row -> dropped scatter
         col = jnp.clip(pos, 0, K - 1)
-        mb_rel = mb_rel.at[row, col].set(drel_r[perm3], mode="drop")
-        mb_src = mb_src.at[row, col].set(src_r[perm3], mode="drop")
-        mb_payload = mb_payload.at[row, col].set(pay_r[perm3], mode="drop")
-        mb_valid = mb_valid.at[row, col].set(fits, mode="drop")
+        mb_rel = mb_rel.at[col, row].set(drel_s, mode="drop")
+        mb_src = mb_src.at[col, row].set(src_s, mode="drop")
+        for p in range(P):
+            mb_payload = mb_payload.at[col, p, row].set(
+                ops3[5 + p], mode="drop")
+        mb_valid = mb_valid.at[col, row].set(fits, mode="drop")
         overflow_step = comm.all_sum(
             jnp.sum(ok_s & (pos >= K), dtype=jnp.int32)) + bucket_ovf
 
@@ -283,13 +302,13 @@ class JaxEngine:
             _u32sum(jnp.where(fire, mix32_jnp(FIRED, node_ids), 0)))
         d_abs = base + jnp.where(deliver, st.mb_rel, 0).astype(jnp.int64)
         recv_mix = mix32_jnp(
-            RECV, jnp.broadcast_to(node_ids[:, None], (n, K)),
+            RECV, jnp.broadcast_to(node_ids[None, :], (K, n)),
             st.mb_src, _tlo(d_abs), _thi(d_abs),
-            st.mb_payload[:, :, 0])
+            st.mb_payload[:, 0, :])
         recv_hash = comm.all_sum(_u32sum(jnp.where(deliver, recv_mix, 0)))
         dt_abs = t + drel64
         sent_mix = mix32_jnp(SENT, src_f, dst_f, _tlo(dt_abs), _thi(dt_abs),
-                             pay_f[:, 0])
+                             pay_cols[0])
         sent_hash = comm.all_sum(_u32sum(jnp.where(ok, sent_mix, 0)))
         sent_count = comm.all_sum(jnp.sum(ok, dtype=jnp.int32))
 

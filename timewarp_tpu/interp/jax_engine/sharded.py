@@ -37,7 +37,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ...core.scenario import Scenario
 from ...net.delays import LinkModel
-from .common import LocalComm
+from .common import LocalComm, group_rank
 from .edge_engine import EdgeEngine, EdgeState
 from .engine import EngineState, JaxEngine
 
@@ -237,17 +237,19 @@ class ShardedEngine(_ShardedDriver, JaxEngine):
 
     # -- the all_to_all exchange -----------------------------------------
 
-    def _exchange(self, ok, drel, src_f, dst_f, pay_f):
+    def _exchange(self, ok, drel, src_f, dst_f, smrank, pay_cols):
         comm = self.comm
         D, nl, B = comm.n_shards, comm.n_local, self.bucket_cap
-        S = ok.shape[0]
-        P = pay_f.shape[1]
-        # destination shard of each message; invalid -> sentinel D
+        # destination shard of each message; invalid -> sentinel D.
+        # One variadic sort groups messages by shard with all values
+        # riding along (no argsort + gather chain); in-bucket order is
+        # irrelevant — insertion downstream sorts on smrank.
         dshard = jnp.where(ok, dst_f // jnp.int32(nl), jnp.int32(D))
-        perm = jnp.argsort(dshard, stable=True)   # sender-major per shard
-        sk = dshard[perm]
-        rank = jnp.arange(S, dtype=jnp.int32) - jnp.searchsorted(
-            sk, sk, side="left").astype(jnp.int32)
+        ops = jax.lax.sort(
+            (dshard, drel, src_f, dst_f, smrank) + pay_cols,
+            dimension=0, num_keys=1)
+        sk = ops[0]
+        rank = group_rank(sk)
         fits = (sk < D) & (rank < B)
         brow = jnp.where(fits, sk, D)             # -> dropped scatter
         bcol = jnp.clip(rank, 0, B - 1)
@@ -255,48 +257,48 @@ class ShardedEngine(_ShardedDriver, JaxEngine):
             jnp.sum((sk < D) & (rank >= B), dtype=jnp.int32))
 
         def scat(x):
-            buf = jnp.zeros((D, B) + x.shape[1:], x.dtype)
-            return buf.at[brow, bcol].set(x[perm], mode="drop")
+            buf = jnp.zeros((D, B), x.dtype)
+            return buf.at[brow, bcol].set(x, mode="drop")
 
         # only fitting entries scatter (brow==D drops the rest), so the
-        # occupancy mask is just "slot was written" — note `fits` is in
-        # *sorted* order already, so it must not go through scat's perm
+        # occupancy mask is just "slot was written"
         b_ok = jnp.zeros((D, B), jnp.int8).at[brow, bcol].set(
             jnp.int8(1), mode="drop")
-        b_drel = scat(drel)
-        b_src = scat(src_f)
-        b_dst = scat(dst_f)
-        b_pay = scat(pay_f)
+        bufs = [b_ok] + [scat(x) for x in ops[1:]]
 
         def a2a(x):
             return jax.lax.all_to_all(
-                x, self.axis, split_axis=0, concat_axis=0)
+                x, self.axis, split_axis=0, concat_axis=0).reshape(D * B)
 
-        r_ok = a2a(b_ok).reshape(D * B).astype(bool)
-        r_drel = a2a(b_drel).reshape(D * B)
-        r_src = a2a(b_src).reshape(D * B)
-        r_dst = a2a(b_dst).reshape(D * B)
-        r_pay = a2a(b_pay).reshape(D * B, P)
+        r_ok = a2a(b_ok).astype(bool)
+        r_drel, r_src, r_dst, r_smrank = (a2a(b) for b in bufs[1:5])
+        r_pay = tuple(a2a(b) for b in bufs[5:])
         # received rows are local: subtract this shard's node offset
         off = jax.lax.axis_index(self.axis).astype(jnp.int32) \
             * jnp.int32(nl)
-        return r_ok, r_drel, r_src, r_dst - off, r_pay, bucket_ovf
+        return (r_ok, r_drel, r_src, r_dst - off, r_smrank, r_pay,
+                bucket_ovf)
 
     # -- sharding specs --------------------------------------------------
 
     def _state_specs(self, st: EngineState) -> EngineState:
         ax = self.axis
 
-        def leaf(x):
+        def leaf(x, last_axis: bool):
             nd = getattr(x, "ndim", 0)
             if nd == 0:
                 return P()
+            if last_axis:
+                return P(*([None] * (nd - 1) + [ax]))
             return P(ax, *([None] * (nd - 1)))
 
         return EngineState(
-            states=jax.tree.map(leaf, st.states),
-            wake=P(ax), mb_rel=leaf(st.mb_rel), mb_src=leaf(st.mb_src),
-            mb_payload=leaf(st.mb_payload), mb_valid=leaf(st.mb_valid),
+            states=jax.tree.map(lambda x: leaf(x, False), st.states),
+            wake=P(ax),
+            mb_rel=leaf(st.mb_rel, True),
+            mb_src=leaf(st.mb_src, True),
+            mb_payload=leaf(st.mb_payload, True),
+            mb_valid=leaf(st.mb_valid, True),
             overflow=P(), bad_dst=P(), bad_delay=P(),
             delivered=P(), steps=P(), time=P(),
         )

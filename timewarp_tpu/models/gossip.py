@@ -40,10 +40,17 @@ def gossip(n: int, *,
            gossip_interval: Microsecond = ms(2),
            bootstrap_us: Microsecond = ms(1),
            end_us: Microsecond = sec(60),
+           steady: bool = False,
            mailbox_cap: int = 16) -> Scenario:
     """Build the gossip scenario. Node 0 starts infected; the run
     quiesces when every node has relayed its ``fanout`` sends (or the
-    ``end_us`` deadline passes)."""
+    ``end_us`` deadline passes).
+
+    ``steady=True`` is the *rumor-mongering / anti-entropy* variant:
+    an infected node keeps relaying to one random peer every
+    ``gossip_interval`` until the deadline (not fanout-bounded) — the
+    classic epidemic steady state, and the dense general-engine
+    regime (every infected node fires co-temporally each round)."""
 
     def step(state, inbox: Inbox, now, i, key):
         hop, lcg = state["hop"], state["lcg"]
@@ -70,12 +77,16 @@ def gossip(n: int, *,
             valid=due[None],
             dst=dst[None],
             payload=jnp.stack([hop1 + 1, jnp.int32(0)])[None])
-        left2 = left1 - due.astype(jnp.int32)
-        nxt2 = jnp.where(due,
-                         jnp.where(left2 > 0,
-                                   now + jnp.int64(gossip_interval),
-                                   jnp.int64(NEVER)),
-                         nxt1)
+        if steady:
+            left2 = left1                     # mongering never exhausts
+            nxt2 = jnp.where(due, now + jnp.int64(gossip_interval), nxt1)
+        else:
+            left2 = left1 - due.astype(jnp.int32)
+            nxt2 = jnp.where(due,
+                             jnp.where(left2 > 0,
+                                       now + jnp.int64(gossip_interval),
+                                       jnp.int64(NEVER)),
+                             nxt1)
         wake = jnp.where((left2 > 0) & alive, nxt2, jnp.int64(NEVER))
         return {"hop": hop1, "lcg": lcg1, "left": left2,
                 "next": nxt2}, out, wake

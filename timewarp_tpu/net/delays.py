@@ -31,7 +31,7 @@ from ..core.rng import bernoulli, normal_f32, split_bits, uniform_int
 
 __all__ = [
     "LinkModel", "FixedDelay", "UniformDelay", "LogNormalDelay",
-    "WithDrop", "FnDelay", "NEVER_CONNECTED",
+    "WithDrop", "FnDelay", "Quantize", "NEVER_CONNECTED",
 ]
 
 #: Drop probability 1 — ≙ the old API's ``NeverConnected`` outcome.
@@ -115,6 +115,33 @@ class WithDrop(LinkModel):
         inner_key = split_bits(b0, b1, 0x1A7E5EED)
         delay, inner_drop = self.inner.sample(src, dst, t, inner_key)
         return delay, drop | inner_drop
+
+
+@dataclass(frozen=True)
+class Quantize(LinkModel):
+    """Round the inner model's delays *up* to a multiple of
+    ``quantum_us`` — time-bucketed batching (SURVEY.md §7 hard part 4).
+
+    The fire-all-at-min superstep delivers every message due at the
+    same instant in one batch; free-running delays make every arrival
+    its own instant, so at scale each superstep does O(N) work to
+    deliver O(1) messages. Aligning arrivals on a grid (with scenario
+    timers on the same grid) turns sparse event streams into dense
+    co-temporal batches — the difference between ~10³ and ~10⁷+
+    delivered-messages/sec at 100k+ nodes. Deterministic and
+    order-preserving: quantization is monotone, so relative arrival
+    order within a link never inverts."""
+    inner: LinkModel
+    quantum_us: int
+
+    @property
+    def needs_key(self):  # type: ignore[override]
+        return self.inner.needs_key
+
+    def sample(self, src, dst, t, key):
+        d, drop = self.inner.sample(src, dst, t, key)
+        q = jnp.int64(self.quantum_us)
+        return ((d + q - 1) // q) * q, drop
 
 
 @dataclass(frozen=True)
