@@ -202,7 +202,12 @@ class RealTime:
                 except (StopIteration, GeneratorExit):
                     return
             else:
-                # tried to suspend during cleanup: hard stop
+                # tried to suspend during cleanup: hard stop. Close an
+                # abandoned AwaitIO coroutine so it neither warns nor
+                # holds resources.
+                if type(eff) is AwaitIO and hasattr(eff.awaitable,
+                                                    "close"):
+                    eff.awaitable.close()
                 gen.close()
                 return
 

@@ -332,14 +332,19 @@ class AioSocket(RawSocket):
     def close(self) -> Program:
         import asyncio
 
-        async def _close() -> None:
+        # fd release is synchronous (close survives an aborted cleanup)
+        try:
+            self._writer.close()
+        except (ConnectionError, OSError):
+            return
+
+        async def _wait() -> None:
             try:
-                self._writer.close()
                 await self._writer.wait_closed()
             except (ConnectionError, OSError, asyncio.CancelledError):
                 pass
 
-        yield AwaitIO(_close())
+        yield AwaitIO(_wait())
 
 
 class _AioListener(NetListener):
@@ -361,13 +366,48 @@ class _AioListener(NetListener):
         return item
 
     def close(self) -> Program:
+        import asyncio
+        import logging
+
         self._closed = True
+        # Resource release is SYNCHRONOUS — if this program is being
+        # torn down (GeneratorExit aborts cleanup at the next
+        # suspension), the port must still come free: a leaked
+        # listening fd would poison the port for the whole process.
+        self._server.close()
 
-        async def _close() -> None:
-            self._server.close()
-            await self._server.wait_closed()
+        def drain() -> None:
+            # Close sockets the kernel accepted that no one ever pulled
+            # from the accept queue (a connect racing server stop):
+            # Python ≥3.12 Server.wait_closed() waits for ALL spawned
+            # transports, so one orphaned connection would wedge the
+            # stop forever.
+            while not self._queue.empty():
+                sock, peer = self._queue.get_nowait()
+                logging.getLogger("timewarp.comm").debug(
+                    "closing never-accepted connection from %s", peer)
+                try:
+                    sock._writer.close()
+                except Exception:  # noqa: BLE001 — best-effort teardown
+                    pass
 
-        yield AwaitIO(_close())
+        drain()
+
+        async def _wait() -> None:
+            # re-drain after a loop tick: a connection whose
+            # connection_made callback was scheduled but had not run at
+            # the synchronous drain gets enqueued only now
+            await asyncio.sleep(0)
+            drain()
+            try:
+                await asyncio.wait_for(self._server.wait_closed(), 5.0)
+            except asyncio.TimeoutError:
+                drain()
+                logging.getLogger("timewarp.comm").warning(
+                    "listener close timed out waiting for spawned "
+                    "connections; proceeding")
+
+        yield AwaitIO(_wait())
 
 
 class AioBackend(NetBackend):

@@ -234,6 +234,8 @@ class Dialog:
                 yield from li.handler(arg, ctx)
             except ThreadKilled:
                 raise
+            except GeneratorExit:   # teardown must unwind
+                raise
             except BaseException as e:  # noqa: BLE001 ≙ invokeListenerSafe
                 _log.error("uncaught error in listener %r: %r", name, e)
 
@@ -246,6 +248,8 @@ class Dialog:
         try:
             return bool((yield from raw_listener((header, raw), ctx)))
         except ThreadKilled:
+            raise
+        except GeneratorExit:   # teardown must unwind
             raise
         except BaseException as e:  # noqa: BLE001
             _log.error("uncaught error in raw listener: %r", e)

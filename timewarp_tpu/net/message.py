@@ -64,9 +64,16 @@ def message(cls: Optional[Type] = None, *, name: Optional[str] = None):
             c = dataclass(frozen=True)(c)
         wire = name if name is not None else c.__name__
         prev = _REGISTRY.get(wire)
-        if prev is not None and prev.__qualname__ != c.__qualname__:
+        if prev is not None and (
+                (prev.__module__, prev.__qualname__)
+                != (c.__module__, c.__qualname__)):
+            # identity must be module-qualified: two distinct classes
+            # both named "Ping" silently replacing each other corrupts
+            # every decode of that wire name
             raise ValueError(
-                f"message name {wire!r} already registered by {prev!r}")
+                f"message name {wire!r} already registered by {prev!r} "
+                f"(from {prev.__module__}); pass @message(name=...) to "
+                "disambiguate")
         _REGISTRY[wire] = c
         c.__message_name__ = wire
         return c

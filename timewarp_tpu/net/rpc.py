@@ -122,6 +122,8 @@ class Rpc:
                 result = yield from m.handler(req, ctx)
             except ThreadKilled:
                 raise
+            except GeneratorExit:   # teardown must unwind
+                raise
             except BaseException as e:  # noqa: BLE001 — RPC boundary
                 if err_type is not None and isinstance(e, err_type):
                     # expected error: travels typed (≙ ExpectedError)

@@ -147,6 +147,8 @@ class SocketFrame:
                            self.peer_addr)
             except ThreadKilled:
                 raise
+            except GeneratorExit:   # teardown must unwind
+                raise
             except BaseException as e:  # noqa: BLE001 ≙ logOnErr handleAll
                 if not self.curator.is_interrupted:
                     _log.warning("server error on %s: %r",
@@ -189,6 +191,8 @@ class SocketFrame:
             def run() -> Program:
                 try:
                     yield from worker()
+                except GeneratorExit:   # teardown must unwind
+                    raise
                 except BaseException as e:  # noqa: BLE001 ≙ reportErrors
                     _log.debug("caught error on %s %s: %r",
                                desc, self.peer_addr, e)
@@ -334,6 +338,8 @@ class Transport:
                                   "%d <- %s", port, peer)
                     except ThreadKilled:
                         raise
+                    except GeneratorExit:   # teardown must unwind
+                        raise
                     except BaseException as e:  # noqa: BLE001
                         lvl = (logging.DEBUG if sf.curator.is_closed
                                else logging.WARNING)
@@ -355,6 +361,8 @@ class Transport:
                     sock, peer = item
                     yield Fork(lambda s=sock, p=peer: handle_conn(s, p))
             except ThreadKilled:
+                raise
+            except GeneratorExit:   # teardown must unwind
                 raise
             except BaseException as e:  # noqa: BLE001
                 lvl = (logging.DEBUG if server_curator.is_closed
@@ -413,6 +421,8 @@ class Transport:
                     yield from sock.close()
                 return  # frame closed ⇒ done
             except ThreadKilled:
+                raise
+            except GeneratorExit:   # teardown must unwind
                 raise
             except BaseException as e:  # noqa: BLE001 ≙ catchAll
                 if sf.curator.is_interrupted:
