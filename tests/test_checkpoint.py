@@ -1,0 +1,60 @@
+"""Checkpoint/resume to disk (SURVEY.md §5.4): the trace after a
+save→load boundary must equal the uninterrupted run bit-for-bit."""
+
+import numpy as np
+import pytest
+
+from timewarp_tpu.interp.jax_engine.edge_engine import EdgeEngine
+from timewarp_tpu.interp.jax_engine.engine import JaxEngine
+from timewarp_tpu.models.token_ring import token_ring, token_ring_links
+from timewarp_tpu.net.delays import UniformDelay
+from timewarp_tpu.utils.checkpoint import load_state, save_state
+
+
+def test_general_engine_disk_resume_parity(tmp_path):
+    sc = token_ring(48, n_tokens=8, think_us=2_000, bootstrap_us=1000,
+                    end_us=200_000, with_observer=True, mailbox_cap=16)
+    link = token_ring_links(48)
+    eng = JaxEngine(sc, link)
+    _, full = eng.run(300)
+    mid, first = eng.run(120)
+    path = tmp_path / "ckpt.npz"
+    save_state(str(path), mid, meta={"scenario": sc.name, "seed": 0})
+    loaded, meta = load_state(str(path), eng.init_state(),
+                              expect_meta={"scenario": sc.name})
+    assert meta["seed"] == 0
+    _, rest = eng.run(180, state=loaded)
+    assert np.array_equal(
+        np.concatenate([first.times, rest.times]), full.times)
+    assert np.array_equal(
+        np.concatenate([first.recv_hash, rest.recv_hash]), full.recv_hash)
+
+
+def test_edge_engine_disk_resume_parity(tmp_path):
+    sc = token_ring(32, n_tokens=8, think_us=1_000, bootstrap_us=1000,
+                    end_us=150_000, with_observer=False, mailbox_cap=4)
+    link = UniformDelay(200, 900)
+    eng = EdgeEngine(sc, link)
+    _, full = eng.run(300)
+    mid, first = eng.run(120)
+    path = tmp_path / "edge.npz"
+    save_state(str(path), mid)
+    loaded, _ = load_state(str(path), eng.init_state())
+    _, rest = eng.run(180, state=loaded)
+    assert np.array_equal(
+        np.concatenate([first.times, rest.times]), full.times)
+
+
+def test_checkpoint_rejects_mismatched_config(tmp_path):
+    sc = token_ring(32, n_tokens=8, with_observer=False)
+    eng = EdgeEngine(sc, UniformDelay(200, 900))
+    mid, _ = eng.run(50)
+    path = tmp_path / "ckpt.npz"
+    save_state(str(path), mid, meta={"scenario": sc.name})
+    other = EdgeEngine(token_ring(64, n_tokens=8, with_observer=False),
+                       UniformDelay(200, 900))
+    with pytest.raises(ValueError, match="does not match template"):
+        load_state(str(path), other.init_state())
+    with pytest.raises(ValueError, match="meta mismatch"):
+        load_state(str(path), eng.init_state(),
+                   expect_meta={"scenario": "something-else"})

@@ -1,0 +1,64 @@
+"""Bench log reader — ≙ `/root/reference/bench/Network/LogReader/
+Main.hs:61-119`: parse sender and receiver logs, join the four
+timestamps of each message id, emit aligned ``measures.csv`` rows
+``MsgId,PingSent,PingReceived,PongSent,PongReceived`` (missing points
+left empty, like the reference's sparse LogEntry merge).
+"""
+
+from __future__ import annotations
+
+import csv
+from typing import Dict, Iterable, List
+
+from .commons import MeasureEvent, parse_measure_line
+
+__all__ = ["join_measures", "write_csv", "read_log_lines"]
+
+_COLS = [MeasureEvent.PING_SENT, MeasureEvent.PING_RECEIVED,
+         MeasureEvent.PONG_SENT, MeasureEvent.PONG_RECEIVED]
+
+
+def read_log_lines(path: str) -> List[str]:
+    with open(path, encoding="utf-8") as f:
+        return f.readlines()
+
+
+def join_measures(*line_sources: Iterable[str]) -> Dict[int, dict]:
+    """Merge measure lines from any number of logs into
+    ``{mid: {event: µs, "payload": len}}`` (≙ ``analyze`` building the
+    per-id map, LogReader/Main.hs:76-96). A duplicate event for one id
+    keeps the first occurrence and counts the duplicate."""
+    table: Dict[int, dict] = {}
+    dups = 0
+    for lines in line_sources:
+        for line in lines:
+            parsed = parse_measure_line(line)
+            if parsed is None:
+                continue
+            ev, mid, plen, t = parsed
+            row = table.setdefault(mid, {"payload": plen})
+            if ev in row:
+                dups += 1
+                continue
+            row[ev] = t
+    if dups:
+        table["__duplicates__"] = dups  # surfaced, never silent
+    return table
+
+
+def write_csv(table: Dict[int, dict], path: str) -> int:
+    """Write aligned rows sorted by message id (≙ the printed table,
+    LogReader/Main.hs:97-119); returns the row count."""
+    dups = table.pop("__duplicates__", 0)
+    with open(path, "w", newline="", encoding="utf-8") as f:
+        w = csv.writer(f)
+        w.writerow(["MsgId", "PayloadBytes"] + [c.name for c in _COLS])
+        n = 0
+        for mid in sorted(k for k in table if isinstance(k, int)):
+            row = table[mid]
+            w.writerow([mid, row.get("payload", "")] +
+                       [row.get(c, "") for c in _COLS])
+            n += 1
+    if dups:
+        table["__duplicates__"] = dups
+    return n

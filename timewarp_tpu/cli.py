@@ -1,0 +1,182 @@
+"""Scenario runner CLI — the framework's executable surface (≙ the
+reference's per-executable ``optparse-simple`` CLIs, SenderOptions.hs /
+ReceiverOptions.hs / the cabal executables, SURVEY.md §5.6).
+
+Usage::
+
+    python -m timewarp_tpu token-ring --nodes 64 --engine edge \
+        --steps 500 --link uniform:1000:5000 --trace-csv trace.csv
+    python -m timewarp_tpu gossip --nodes 1024 --engine general --steady
+    python -m timewarp_tpu praos --nodes 4096 --engine sharded --devices 8
+    python -m timewarp_tpu ping-pong --engine oracle
+
+Prints one JSON summary line; ``--trace-csv`` dumps the superstep
+trace; ``--save`` / ``--resume`` checkpoint through
+utils/checkpoint.py.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+__all__ = ["main"]
+
+
+def parse_link(spec: str):
+    """``fixed:D`` | ``uniform:LO:HI`` | ``lognormal:MEDIAN:SIGMA`` —
+    optionally wrapped ``drop:P:<inner>`` and/or ``quantize:Q:<inner>``."""
+    from .net.delays import (FixedDelay, LogNormalDelay, Quantize,
+                             UniformDelay, WithDrop)
+    parts = spec.split(":")
+    kind = parts[0]
+    if kind == "drop":
+        return WithDrop(parse_link(":".join(parts[2:])), float(parts[1]))
+    if kind == "quantize":
+        return Quantize(parse_link(":".join(parts[2:])), int(parts[1]))
+    if kind == "fixed":
+        return FixedDelay(int(parts[1]))
+    if kind == "uniform":
+        return UniformDelay(int(parts[1]), int(parts[2]))
+    if kind == "lognormal":
+        return LogNormalDelay(int(parts[1]), float(parts[2]))
+    raise SystemExit(f"unknown link spec {spec!r}")
+
+
+def build_scenario(args):
+    if args.scenario == "token-ring":
+        from .models.token_ring import token_ring
+        return token_ring(
+            args.nodes, n_tokens=args.tokens or 1,
+            think_us=args.think_us, end_us=args.end_us,
+            with_observer=args.observer, mailbox_cap=args.mailbox_cap)
+    if args.scenario == "gossip":
+        from .models.gossip import gossip
+        return gossip(args.nodes, fanout=args.fanout,
+                      end_us=args.end_us, steady=args.steady,
+                      mailbox_cap=args.mailbox_cap)
+    if args.scenario == "praos":
+        from .models.praos import praos
+        return praos(args.nodes, n_slots=args.slots,
+                     leader_prob=args.leader_prob, fanout=args.fanout,
+                     mailbox_cap=args.mailbox_cap)
+    if args.scenario == "ping-pong":
+        from .models.ping_pong import ping_pong
+        return ping_pong(rounds=args.tokens or 10)
+    raise SystemExit(f"unknown scenario {args.scenario!r}")
+
+
+def build_engine(args, sc, link):
+    if args.engine == "oracle":
+        from .interp.ref.superstep import SuperstepOracle
+        return SuperstepOracle(sc, link, seed=args.seed)
+    if args.engine == "general":
+        from .interp.jax_engine.engine import JaxEngine
+        return JaxEngine(sc, link, seed=args.seed)
+    if args.engine == "edge":
+        from .interp.jax_engine.edge_engine import EdgeEngine
+        return EdgeEngine(sc, link, seed=args.seed, cap=args.edge_cap)
+    if args.engine in ("sharded", "sharded-edge"):
+        from .interp.jax_engine.sharded import (
+            ShardedEdgeEngine, ShardedEngine, make_mesh)
+        mesh = make_mesh(args.devices)
+        cls = (ShardedEdgeEngine if args.engine == "sharded-edge"
+               else ShardedEngine)
+        if cls is ShardedEdgeEngine:
+            return cls(sc, link, mesh, seed=args.seed, cap=args.edge_cap)
+        return cls(sc, link, mesh, seed=args.seed)
+    raise SystemExit(f"unknown engine {args.engine!r}")
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="timewarp_tpu",
+        description="Run a distributed-system scenario under an "
+                    "interchangeable interpreter (README.md:6-15).")
+    p.add_argument("scenario",
+                   choices=["token-ring", "gossip", "praos", "ping-pong"])
+    p.add_argument("--engine", default="general",
+                   choices=["oracle", "general", "edge", "sharded",
+                            "sharded-edge"])
+    p.add_argument("--nodes", type=int, default=64)
+    p.add_argument("--steps", type=int, default=1000,
+                   help="max supersteps to run")
+    p.add_argument("--link", default="uniform:1000:5000",
+                   help="fixed:D | uniform:LO:HI | lognormal:MED:SIGMA"
+                        " | drop:P:<inner> | quantize:Q:<inner>")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--devices", type=int, default=None,
+                   help="mesh size for sharded engines (default: all)")
+    p.add_argument("--mailbox-cap", type=int, default=8)
+    p.add_argument("--edge-cap", type=int, default=2)
+    p.add_argument("--tokens", type=int, default=None,
+                   help="token-ring: initial tokens; ping-pong: rounds")
+    p.add_argument("--think-us", type=int, default=3_000_000)
+    p.add_argument("--end-us", type=int, default=20_000_000)
+    p.add_argument("--observer", action="store_true")
+    p.add_argument("--steady", action="store_true",
+                   help="gossip: rumor-mongering steady state")
+    p.add_argument("--fanout", type=int, default=8)
+    p.add_argument("--slots", type=int, default=10)
+    p.add_argument("--leader-prob", type=float, default=0.05)
+    p.add_argument("--trace-csv", default=None)
+    p.add_argument("--save", default=None,
+                   help="write the final engine state to this .npz")
+    p.add_argument("--resume", default=None,
+                   help="resume from a checkpoint written by --save")
+    p.add_argument("--log-config", default=None,
+                   help="YAML severity tree (utils/logconfig.py)")
+    args = p.parse_args(argv)
+
+    from .utils.logconfig import load_log_config
+    load_log_config(args.log_config)
+
+    sc = build_scenario(args)
+    link = parse_link(args.link)
+    engine = build_engine(args, sc, link)
+
+    if args.engine == "oracle":
+        trace = engine.run(args.steps)
+        final_info = {"overflow": engine.overflow_total,
+                      "bad_dst": engine.bad_dst_total}
+    else:
+        state = None
+        if args.resume:
+            from .utils.checkpoint import load_state
+            state, ck_meta = load_state(args.resume, engine.init_state(),
+                                        expect_meta={"scenario": sc.name})
+            if ck_meta.get("seed", args.seed) != args.seed:
+                # the RNG stream is part of the state: resuming under a
+                # different seed would silently diverge from both runs
+                args.seed = ck_meta["seed"]
+                engine = build_engine(args, sc, link)
+        final, trace = engine.run(args.steps, state=state)
+        if args.save:
+            from .utils.checkpoint import save_state
+            save_state(args.save, final,
+                       meta={"scenario": sc.name, "seed": args.seed})
+        final_info = {"overflow": int(final.overflow),
+                      "steps": int(final.steps),
+                      "virtual_time_us": int(final.time)}
+
+    if args.trace_csv:
+        import csv
+        with open(args.trace_csv, "w", newline="") as f:
+            w = csv.writer(f)
+            w.writerow(["t_us", "fired", "fired_hash", "recv",
+                        "recv_hash", "sent", "sent_hash", "overflow"])
+            for i in range(len(trace)):
+                w.writerow(trace.row(i))
+
+    print(json.dumps({
+        "scenario": sc.name, "engine": args.engine,
+        "supersteps": len(trace),
+        "delivered": trace.total_delivered(),
+        **final_info,
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

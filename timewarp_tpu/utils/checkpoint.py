@@ -1,0 +1,77 @@
+"""Checkpoint/resume to disk — SURVEY.md §5.4.
+
+The reference has nothing here; the TPU build gets it almost for free
+because every engine's complete simulation state is one pytree of
+arrays (EngineState / EdgeState). Serialization is a plain ``.npz``
+with a JSON tree-structure header — no framework dependency, stable
+across hosts, and exact (integer state; the float leaves, if a
+scenario adds any, round-trip bit-for-bit through npz).
+
+Resume is ``engine.run(steps, state=load_state(path))`` — mid-run
+trace-parity across a save/load boundary is pinned by
+tests/test_checkpoint.py.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any
+
+import jax
+import numpy as np
+
+__all__ = ["save_state", "load_state"]
+
+
+def save_state(path: str, state: Any, *, meta: dict = None) -> None:
+    """Write a state pytree to ``path`` (.npz). ``meta`` (JSON-able)
+    rides along — scenario name, seed, anything the loader wants to
+    validate against."""
+    leaves, treedef = jax.tree.flatten(state)
+    arrays = {f"leaf_{i}": np.asarray(jax.device_get(x))
+              for i, x in enumerate(leaves)}
+    arrays["__treedef__"] = np.frombuffer(
+        str(treedef).encode(), dtype=np.uint8)
+    arrays["__meta__"] = np.frombuffer(
+        json.dumps(meta or {}).encode(), dtype=np.uint8)
+    arrays["__n__"] = np.asarray(len(leaves))
+    with open(path, "wb") as f:
+        np.savez(f, **arrays)
+
+
+def load_state(path: str, like: Any, *, expect_meta: dict = None):
+    """Read a state pytree saved by :func:`save_state`. ``like`` is a
+    template pytree with the same structure (e.g. ``engine.init_state()``)
+    — the loaded leaves are checked against its shapes/dtypes, so a
+    checkpoint from a different scenario config fails loudly instead of
+    resuming garbage. Returns ``(state, meta)``."""
+    with np.load(path) as z:
+        n = int(z["__n__"])
+        meta = json.loads(bytes(z["__meta__"].tobytes()).decode())
+        saved_treedef = bytes(z["__treedef__"].tobytes()).decode()
+        leaves = [z[f"leaf_{i}"] for i in range(n)]
+    t_leaves, treedef = jax.tree.flatten(like)
+    if len(t_leaves) != n:
+        raise ValueError(
+            f"checkpoint has {n} leaves, template has {len(t_leaves)}")
+    if saved_treedef != str(treedef):
+        # leaf order is structure-dependent: same leaf count/shapes with
+        # a different tree would resume with fields silently swapped
+        raise ValueError(
+            f"checkpoint tree structure does not match template:\n"
+            f"  saved:    {saved_treedef}\n  template: {treedef}")
+    for i, (got, want) in enumerate(zip(leaves, t_leaves)):
+        w = np.asarray(want)
+        if got.shape != w.shape or got.dtype != w.dtype:
+            raise ValueError(
+                f"checkpoint leaf {i}: {got.shape}/{got.dtype} does not "
+                f"match template {w.shape}/{w.dtype}")
+    if expect_meta:
+        for k, v in expect_meta.items():
+            if meta.get(k) != v:
+                raise ValueError(
+                    f"checkpoint meta mismatch: {k}={meta.get(k)!r}, "
+                    f"expected {v!r}")
+    state = jax.tree.unflatten(treedef, [jax.numpy.asarray(x)
+                                         for x in leaves])
+    return state, meta

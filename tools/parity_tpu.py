@@ -1,0 +1,109 @@
+"""TPU-in-the-loop parity artifact: oracle-on-CPU vs engine-on-TPU.
+
+Runs the host oracle with all jax computation pinned to the CPU
+backend and the batched engines on the default accelerator (the TPU
+when one is attached), compares the event traces bit-for-bit, and
+writes ``PARITY_TPU.json`` with per-config digests. Integer-only link
+models, so equality is exact across backends (core/rng.py, SURVEY.md
+§5.2).
+
+Configs: ping-pong (BASELINE config 1), token-ring 64 fixed-latency
+(config 2, edge engine), token-ring 64 w/ observer + uniform links
+(general engine), gossip-64 w/ drops (all integers).
+
+Usage: ``python tools/parity_tpu.py`` (writes PARITY_TPU.json at the
+repo root). Exits nonzero on any trace mismatch. If no accelerator is
+attached the artifact records the platform actually used.
+"""
+
+import hashlib
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from timewarp_tpu.utils import jaxconfig  # noqa: F401,E402
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+
+
+def trace_sha(tr) -> str:
+    h = hashlib.sha256()
+    for f in ("times", "fired_count", "fired_hash", "recv_count",
+              "recv_hash", "sent_count", "sent_hash", "overflow"):
+        h.update(np.ascontiguousarray(getattr(tr, f)).tobytes())
+    return h.hexdigest()[:16]
+
+
+def main() -> int:
+    from timewarp_tpu.interp.jax_engine.edge_engine import EdgeEngine
+    from timewarp_tpu.interp.jax_engine.engine import JaxEngine
+    from timewarp_tpu.interp.ref.superstep import SuperstepOracle
+    from timewarp_tpu.models.gossip import gossip
+    from timewarp_tpu.models.ping_pong import ping_pong
+    from timewarp_tpu.models.token_ring import token_ring, token_ring_links
+    from timewarp_tpu.net.delays import (
+        FixedDelay, UniformDelay, WithDrop)
+    from timewarp_tpu.trace.events import TraceMismatch, assert_traces_equal
+
+    platform = jax.devices()[0].platform
+    cpu = jax.devices("cpu")[0]
+
+    configs = {
+        "ping-pong": (
+            ping_pong(rounds=50), UniformDelay(500, 2_000),
+            JaxEngine, 400),
+        "token-ring-64-fixed": (
+            token_ring(64, n_tokens=16, think_us=2_000, bootstrap_us=1000,
+                       end_us=400_000, with_observer=False, mailbox_cap=6),
+            FixedDelay(1_500), EdgeEngine, 600),
+        "token-ring-64-observer": (
+            token_ring(64, n_tokens=8, think_us=3_000, bootstrap_us=1000,
+                       end_us=300_000, with_observer=True, mailbox_cap=16),
+            token_ring_links(64), JaxEngine, 600),
+        "gossip-64-drop": (
+            gossip(64, fanout=6, think_us=3_000, gossip_interval=1_000,
+                   end_us=5_000_000),
+            WithDrop(UniformDelay(2_000, 30_000), 0.15), JaxEngine, 800),
+    }
+
+    out = {"engine_platform": platform, "oracle_platform": "cpu",
+           "configs": {}, "ok": True}
+    for name, (sc, link, eng_cls, steps) in configs.items():
+        with jax.default_device(cpu):
+            otrace = SuperstepOracle(sc, link).run(20 * steps)
+        engine = eng_cls(sc, link)
+        _, etrace = engine.run(steps)
+        entry = {
+            "supersteps": len(etrace),
+            "delivered": etrace.total_delivered(),
+            "oracle_sha": trace_sha(otrace),
+            "engine_sha": trace_sha(etrace),
+        }
+        try:
+            limit = len(etrace) if len(etrace) < len(otrace) else None
+            assert_traces_equal(otrace, etrace, "oracle-cpu",
+                                f"engine-{platform}", limit=limit)
+            entry["equal"] = True
+        except TraceMismatch as e:
+            entry["equal"] = False
+            entry["mismatch"] = str(e)
+            out["ok"] = False
+        out["configs"][name] = entry
+        print(f"{name}: {'OK' if entry['equal'] else 'MISMATCH'} "
+              f"({entry['supersteps']} supersteps, "
+              f"{entry['delivered']} delivered)")
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    with open(os.path.join(root, "PARITY_TPU.json"), "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps({"parity_tpu_ok": out["ok"],
+                      "engine_platform": platform}))
+    return 0 if out["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
