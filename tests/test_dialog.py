@@ -319,3 +319,77 @@ def test_duplicate_listener_rejected():
         return False
 
     assert run_emulation(main)
+
+
+def test_proxy_forwards_unparsed_messages():
+    """The proxy scenario (playground Main.hs:238-287): a middle node
+    routes messages by HEADER ONLY, re-sending the raw bytes with
+    ``send_r`` without ever parsing the content — then gates typed
+    dispatch off (returns False). The destination parses normally."""
+    net = EmulatedBackend(FixedDelay(1000))
+    proxy_d = Dialog(Transport(net, host="proxy"))
+    dst_d = Dialog(Transport(net, host="dest"))
+    cli_d = Dialog(Transport(net, host="client"))
+    proxy_addr, dst_addr = ("proxy", 6100), ("dest", 6200)
+    arrived, proxied = [], []
+
+    def proxy_raw(hr, ctx):
+        header, raw = hr
+        # route on the header; content stays opaque bytes
+        proxied.append((header, proxy_d.packing.extract_name(raw)))
+        yield from proxy_d.send_r(dst_addr, header, raw)
+        return False  # no local dispatch at the proxy
+
+    def on_known(msg, ctx):
+        arrived.append(msg)
+        yield GetTime()
+
+    def main() -> Program:
+        stop_p = yield from proxy_d.listen(AtPort(6100), [], proxy_raw)
+        stop_d = yield from dst_d.listen(AtPort(6200),
+                                         [Listener(Known, on_known)])
+        yield from cli_d.send_h(proxy_addr, ("route", 1), Known(7))
+        yield from cli_d.send_h(proxy_addr, ("route", 2), Known(9))
+        yield Wait(80_000)
+        yield from cli_d.transport.close(proxy_addr)
+        yield from proxy_d.transport.close(dst_addr)
+        yield from stop_p()
+        yield from stop_d()
+        return True
+
+    assert run_emulation(main)
+    assert arrived == [Known(7), Known(9)]
+    assert proxied == [(("route", 1), "Known"), (("route", 2), "Known")]
+
+
+def test_closing_server_listen_stop_cycles():
+    """closingServerScenario (playground Main.hs:320-343): bind, serve,
+    stop, re-bind the same port repeatedly; each generation of the
+    server sees only its own messages."""
+    net = EmulatedBackend(FixedDelay(500))
+    addr = ("127.0.0.1", 6300)
+    srv_tr = Transport(net)
+    srv = Dialog(srv_tr)
+    seen = []
+
+    def main() -> Program:
+        for gen in range(3):
+            got = []
+            seen.append(got)
+
+            def on_known(msg, ctx, got=got):
+                got.append(msg.v)
+                yield GetTime()
+
+            stop = yield from srv.listen(AtPort(6300),
+                                         [Listener(Known, on_known)])
+            cli = Dialog(Transport(net, host=f"client{gen}"))
+            yield from cli.send(addr, Known(gen * 10))
+            yield from cli.send(addr, Known(gen * 10 + 1))
+            yield Wait(30_000)
+            yield from cli.transport.close(addr)
+            yield from stop()
+        return True
+
+    assert run_emulation(main)
+    assert seen == [[0, 1], [10, 11], [20, 21]]

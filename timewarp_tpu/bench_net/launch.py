@@ -44,9 +44,15 @@ def launch(*, msgs: int = 1000, threads: int = 5, rate: int = 0,
     """Run the two-node bench once; returns the joined measure table.
     Emulated runs complete in wall-clock milliseconds regardless of the
     virtual ``duration_s`` (the whole point of the emulator)."""
+    from ..manage.sync import Flag
+
     send_log = logging.getLogger("bench.sender")
     recv_log = logging.getLogger("bench.receiver")
     # ≙ defaultLogConfig: measure streams at Info, comm muted to Error
+    # (levels restored below — launch must not permanently reconfigure
+    # the host process's logging)
+    prior_levels = {name: logging.getLogger(name).level
+                    for name in ("bench", "timewarp.comm")}
     configure_logging({
         "bench": {"severity": "Info"},
         "timewarp": {"comm": {"severity": "Error"}},
@@ -70,8 +76,7 @@ def launch(*, msgs: int = 1000, threads: int = 5, rate: int = 0,
             backend = EmulatedBackend(FixedDelay(delay_us), seed=seed)
             run = run_emulation
 
-        from ..manage.sync import Flag as _Flag
-        recv_ready = _Flag()
+        recv_ready = Flag()
         recv_prog = receiver(backend, port=port, host=host,
                              duration_us=duration_us + 2_000_000,
                              no_pong=no_pong, ready=recv_ready,
@@ -82,7 +87,6 @@ def launch(*, msgs: int = 1000, threads: int = 5, rate: int = 0,
                            payload_bound=payload_bound, seed=seed,
                            logger=send_log)
 
-        from ..manage.sync import Flag
         recv_done, send_done = Flag(), Flag()
 
         def wrap(prog, flag):
@@ -108,6 +112,8 @@ def launch(*, msgs: int = 1000, threads: int = 5, rate: int = 0,
     finally:
         send_log.removeHandler(sh)
         recv_log.removeHandler(rh)
+        for name, level in prior_levels.items():
+            logging.getLogger(name).setLevel(level)
 
     if logs_dir:
         os.makedirs(logs_dir, exist_ok=True)

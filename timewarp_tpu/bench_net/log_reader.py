@@ -48,8 +48,9 @@ def join_measures(*line_sources: Iterable[str]) -> Dict[int, dict]:
 
 def write_csv(table: Dict[int, dict], path: str) -> int:
     """Write aligned rows sorted by message id (≙ the printed table,
-    LogReader/Main.hs:97-119); returns the row count."""
-    dups = table.pop("__duplicates__", 0)
+    LogReader/Main.hs:97-119); returns the row count. The
+    ``__duplicates__`` sentinel (if any) is left untouched in the
+    table — the int-key filter below skips it."""
     with open(path, "w", newline="", encoding="utf-8") as f:
         w = csv.writer(f)
         w.writerow(["MsgId", "PayloadBytes"] + [c.name for c in _COLS])
@@ -59,6 +60,4 @@ def write_csv(table: Dict[int, dict], path: str) -> int:
             w.writerow([mid, row.get("payload", "")] +
                        [row.get(c, "") for c in _COLS])
             n += 1
-    if dups:
-        table["__duplicates__"] = dups
     return n

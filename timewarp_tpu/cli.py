@@ -137,6 +137,10 @@ def main(argv=None) -> int:
     engine = build_engine(args, sc, link)
 
     if args.engine == "oracle":
+        if args.save or args.resume:
+            raise SystemExit(
+                "--save/--resume need an engine state; the oracle "
+                "keeps host-side state — pick a batched engine")
         trace = engine.run(args.steps)
         final_info = {"overflow": engine.overflow_total,
                       "bad_dst": engine.bad_dst_total}

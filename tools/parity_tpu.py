@@ -84,9 +84,15 @@ def main() -> int:
             "engine_sha": trace_sha(etrace),
         }
         try:
-            limit = len(etrace) if len(etrace) < len(otrace) else None
+            # a shorter engine trace is only legitimate when the step
+            # cap was actually hit; premature quiescence (fewer rows
+            # than budgeted) must fail the length check, not be
+            # prefix-compared away
+            truncated = len(etrace) == steps and len(otrace) > steps
+            entry["truncated_at_step_cap"] = truncated
             assert_traces_equal(otrace, etrace, "oracle-cpu",
-                                f"engine-{platform}", limit=limit)
+                                f"engine-{platform}",
+                                limit=steps if truncated else None)
             entry["equal"] = True
         except TraceMismatch as e:
             entry["equal"] = False
