@@ -1,7 +1,8 @@
-"""Shared machinery of the batched engines: trace-row container,
-digest helpers, int32 sentinels, and the device-communication
-abstraction that lets one superstep implementation run single-chip or
-sharded over a mesh (sharded.py)."""
+"""Shared machinery of the batched engines: trace-row container and
+the device-communication abstraction that lets one superstep
+implementation run single-chip or sharded over a mesh
+(parallel/mesh.py). The integer primitives live in
+:mod:`timewarp_tpu.ops` and are re-exported here for the engines."""
 
 from __future__ import annotations
 
@@ -11,27 +12,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ...ops.numeric import I32MAX, group_rank, thi, tlo, u32sum
+
 __all__ = ["LocalComm", "StepOut", "I32MAX", "group_rank", "u32sum",
            "tlo", "thi"]
-
-I32MAX = np.int32(2**31 - 1)
-
-
-def group_rank(sorted_keys: jax.Array) -> jax.Array:
-    """Rank of each element within its run of equal keys (keys must be
-    sorted ascending): ``iota - cummax(run-start indices)``.
-
-    Replaces ``searchsorted(keys, keys, 'left')`` in the routing path —
-    on TPU searchsorted lowers to ~log2(S) chained gather rounds
-    (~1 ms each at 131k elements, profiling/superstep_breakdown.md)
-    while the associative cummax scan is elementwise-cheap."""
-    S = sorted_keys.shape[0]
-    iota = jnp.arange(S, dtype=jnp.int32)
-    boundary = jnp.concatenate([
-        jnp.ones((1,), bool), sorted_keys[1:] != sorted_keys[:-1]])
-    first = jax.lax.associative_scan(
-        jnp.maximum, jnp.where(boundary, iota, 0))
-    return iota - first
 
 
 class StepOut(NamedTuple):
@@ -45,18 +29,6 @@ class StepOut(NamedTuple):
     sent_count: jax.Array
     sent_hash: jax.Array
     overflow: jax.Array
-
-
-def u32sum(x: jax.Array) -> jax.Array:
-    return jnp.sum(x.astype(jnp.uint32), dtype=jnp.uint32)
-
-
-def tlo(t: jax.Array) -> jax.Array:
-    return (t & jnp.int64(0xFFFFFFFF)).astype(jnp.uint32)
-
-
-def thi(t: jax.Array) -> jax.Array:
-    return ((t >> jnp.int64(32)) & jnp.int64(0xFFFFFFFF)).astype(jnp.uint32)
 
 
 class LocalComm:

@@ -1,167 +1,44 @@
 """Sharded engines: the same superstep semantics over a device mesh.
 
-SURVEY.md §2.5/§5.8: simulated-node message passing maps onto XLA
-collectives over the mesh's ICI — ``ppermute`` for fixed shift
-topologies (the token ring's neighbor exchange), ``all_to_all`` for
-dynamic destinations — instead of the reference's TCP sockets
-(`/root/reference/src/Control/TimeWarp/Rpc/Transfer.hs:473,577`).
+The mesh/collective layer itself (MeshComm, ShardedDriver, make_mesh)
+lives in :mod:`timewarp_tpu.parallel`; this module binds it to the two
+engines:
 
-:class:`ShardedEdgeEngine` is the edge engine (edge_engine.py) run
-under ``shard_map`` with the node axis sharded. All communication goes
-through :class:`MeshComm`: the global clock min is an ``all_gather`` +
-local reduce, counters and trace digests are ``psum`` (the digests are
-*wrapping uint32 sums*, so the cross-device reduction is exact, not
-approximate), and the ring delivery roll becomes a boundary-slice
-``ppermute`` — one neighbor hop over ICI per superstep, never an
-all-gather of the payload arrays. Requires a pure-shift topology
-(every edge a constant ring offset); anything else needs cross-shard exchange bucketed by
-destination shard (``lax.all_to_all``) — the general sharded engine.
+- :class:`ShardedEdgeEngine` — the edge engine (edge_engine.py) under
+  ``shard_map`` with the node axis sharded; ring delivery is a
+  boundary-slice ``ppermute`` (one ICI neighbor hop per superstep),
+  requiring a pure-shift topology.
+- :class:`ShardedEngine` — the general engine (engine.py) with its
+  exchange stage replaced by destination-shard bucketing + one
+  ``lax.all_to_all`` per superstep.
 
 The acceptance law is unchanged: an 8-device run must reproduce the
-1-device trace **bit-for-bit** (tests/test_sharded.py runs the engine
-on a virtual 8-device CPU mesh against both the 1-device engine and
-the host oracle).
+1-device trace **bit-for-bit** (tests/test_sharded.py runs both
+engines on a virtual 8-device CPU mesh against the 1-device engines
+and the host oracle).
 """
 
 from __future__ import annotations
 
-from functools import partial
-from typing import Optional, Tuple
+from typing import Optional
 
 from ...utils import jaxconfig  # noqa: F401
 
 import jax
 import jax.numpy as jnp
-import numpy as np
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import PartitionSpec as P
 
 from ...core.scenario import Scenario
 from ...net.delays import LinkModel
-from .common import LocalComm, group_rank
+from ...parallel.mesh import Mesh, MeshComm, ShardedDriver, make_mesh
+from .common import group_rank
 from .edge_engine import EdgeEngine, EdgeState
 from .engine import EngineState, JaxEngine
 
 __all__ = ["MeshComm", "ShardedEdgeEngine", "ShardedEngine", "make_mesh"]
 
 
-def make_mesh(n_devices: Optional[int] = None,
-              axis: str = "nodes") -> Mesh:
-    """A 1-D mesh over the first ``n_devices`` available devices."""
-    devs = jax.devices()
-    if n_devices is None:
-        n_devices = len(devs)
-    return Mesh(np.asarray(devs[:n_devices]), (axis,))
-
-
-class MeshComm(LocalComm):
-    """Mesh collectives behind the LocalComm interface; valid only
-    inside a ``shard_map`` body with ``axis`` bound."""
-
-    def __init__(self, axis: str, n_global: int, n_shards: int) -> None:
-        if n_global % n_shards:
-            raise ValueError(
-                f"n_nodes {n_global} not divisible by {n_shards} shards")
-        self.axis = axis
-        self.n_global = n_global
-        self.n_shards = n_shards
-        self.n_local = n_global // n_shards
-
-    def node_ids(self) -> jax.Array:
-        off = jax.lax.axis_index(self.axis).astype(jnp.int32) \
-            * jnp.int32(self.n_local)
-        return off + jnp.arange(self.n_local, dtype=jnp.int32)
-
-    def all_min(self, x: jax.Array) -> jax.Array:
-        # Not ``pmin``: the int64 min-all-reduce fails to lower on the
-        # TPU compiler path ("Supported lowering only of Sum all
-        # reduce"); gathering one scalar per device and reducing
-        # locally lowers everywhere and costs D words on ICI.
-        return jax.lax.all_gather(x, self.axis).min()
-
-    def all_sum(self, x: jax.Array) -> jax.Array:
-        return jax.lax.psum(x, self.axis)
-
-    def roll(self, x: jax.Array, s: int) -> jax.Array:
-        """Global roll by ``s`` along the last (node) axis: local roll +
-        boundary-slice ``ppermute`` to the next shard (and a whole-shard
-        ``ppermute`` when ``s`` spans shards). One ICI neighbor hop for
-        the ring's s=1."""
-        s = s % self.n_global
-        if s == 0:
-            return x
-        D, nl = self.n_shards, self.n_local
-        whole, rem = divmod(s, nl)
-        if whole:
-            perm = [(i, (i + whole) % D) for i in range(D)]
-            x = jax.lax.ppermute(x, self.axis, perm)
-        if rem:
-            tail = x[..., nl - rem:]
-            perm = [(i, (i + 1) % D) for i in range(D)]
-            recv = jax.lax.ppermute(tail, self.axis, perm)
-            x = jnp.concatenate([recv, x[..., :nl - rem]], axis=-1)
-        return x
-
-    def local_rows(self, table: np.ndarray) -> jax.Array:
-        off = jax.lax.axis_index(self.axis).astype(jnp.int32) \
-            * jnp.int32(self.n_local)
-        return jax.lax.dynamic_slice_in_dim(
-            jnp.asarray(table), off, self.n_local, axis=-1)
-
-
-class _ShardedDriver:
-    """Shared ``shard_map`` driver for the sharded engines: state
-    placement with ``NamedSharding`` (so XLA keeps every per-node array
-    resident on its owning device across the whole loop), and the
-    jitted scan / while_loop wrappers. The concrete engine supplies
-    ``_state_specs`` (its state's PartitionSpecs), ``_superstep``, and
-    ``_next_event`` (the quiescence expression, inherited from its
-    local base class)."""
-
-    def init_state(self):
-        st = super().init_state()
-        specs = self._state_specs(st)
-        return jax.tree.map(
-            lambda x, s: jax.device_put(x, NamedSharding(self.mesh, s)),
-            st, specs)
-
-    @partial(jax.jit, static_argnums=(0, 2))
-    def _run_scan(self, st, max_steps: int):
-        specs = self._state_specs(st)
-
-        def body(s):
-            def step(carry, _):
-                return self._superstep(carry, True)
-            return jax.lax.scan(step, s, None, length=max_steps)
-
-        return jax.shard_map(
-            body, mesh=self.mesh, in_specs=(specs,),
-            out_specs=(specs, P()), check_vma=False)(st)
-
-    @partial(jax.jit, static_argnums=(0,))
-    def _run_while(self, st, max_steps):
-        from ...core.scenario import NEVER
-
-        specs = self._state_specs(st)
-        max_steps = jnp.asarray(max_steps, jnp.int64)
-
-        def body_fn(s, ms):
-            start_steps = s.steps
-
-            def cond(carry):
-                nxt = self.comm.all_min(self._next_event(carry))
-                return (nxt < NEVER) & (carry.steps - start_steps < ms)
-
-            def body(carry):
-                return self._superstep(carry, False)[0]
-
-            return jax.lax.while_loop(cond, body, s)
-
-        return jax.shard_map(
-            body_fn, mesh=self.mesh, in_specs=(specs, P()),
-            out_specs=specs, check_vma=False)(st, max_steps)
-
-
-class ShardedEdgeEngine(_ShardedDriver, EdgeEngine):
+class ShardedEdgeEngine(ShardedDriver, EdgeEngine):
     """Edge engine over a mesh: node axis sharded, ring delivery on
     ``ppermute``. Same ``run`` / ``run_quiet`` API as the local engine."""
 
@@ -183,19 +60,10 @@ class ShardedEdgeEngine(_ShardedDriver, EdgeEngine):
     # -- sharding specs --------------------------------------------------
 
     def _state_specs(self, st: EdgeState) -> EdgeState:
-        ax = self.axis
-
-        def leaf(x, last_axis: bool):
-            nd = getattr(x, "ndim", 0)
-            if nd == 0:
-                return P()
-            if last_axis:
-                return P(*([None] * (nd - 1) + [ax]))
-            return P(ax, *([None] * (nd - 1)))
-
+        leaf = self._leaf_spec
         return EdgeState(
             states=jax.tree.map(lambda x: leaf(x, False), st.states),
-            wake=P(ax),
+            wake=P(self.axis),
             q_rel=leaf(st.q_rel, True),
             q_step=leaf(st.q_step, True),
             q_pay=leaf(st.q_pay, True),
@@ -205,7 +73,7 @@ class ShardedEdgeEngine(_ShardedDriver, EdgeEngine):
         )
 
 
-class ShardedEngine(_ShardedDriver, JaxEngine):
+class ShardedEngine(ShardedDriver, JaxEngine):
     """General (dynamic-destination) engine over a mesh: node axis
     sharded, message exchange via destination-shard bucketing + one
     ``lax.all_to_all`` per superstep (SURVEY.md §5.8's general-topology
@@ -282,19 +150,10 @@ class ShardedEngine(_ShardedDriver, JaxEngine):
     # -- sharding specs --------------------------------------------------
 
     def _state_specs(self, st: EngineState) -> EngineState:
-        ax = self.axis
-
-        def leaf(x, last_axis: bool):
-            nd = getattr(x, "ndim", 0)
-            if nd == 0:
-                return P()
-            if last_axis:
-                return P(*([None] * (nd - 1) + [ax]))
-            return P(ax, *([None] * (nd - 1)))
-
+        leaf = self._leaf_spec
         return EngineState(
             states=jax.tree.map(lambda x: leaf(x, False), st.states),
-            wake=P(ax),
+            wake=P(self.axis),
             mb_rel=leaf(st.mb_rel, True),
             mb_src=leaf(st.mb_src, True),
             mb_payload=leaf(st.mb_payload, True),

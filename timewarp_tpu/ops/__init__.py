@@ -1,0 +1,6 @@
+"""Numeric building blocks of the batched engines (see numeric.py for
+the native-layer design stance)."""
+
+from .numeric import I32MAX, group_rank, thi, tlo, u32sum
+
+__all__ = ["I32MAX", "group_rank", "u32sum", "tlo", "thi"]

@@ -1,0 +1,7 @@
+"""Mesh/collective layer: the TPU-native "communication backend"
+(SURVEY.md §2.5) — ppermute rings, all_to_all exchanges, exact psum
+digest reductions — behind one comm interface."""
+
+from .mesh import Mesh, MeshComm, ShardedDriver, make_mesh
+
+__all__ = ["Mesh", "MeshComm", "ShardedDriver", "make_mesh"]

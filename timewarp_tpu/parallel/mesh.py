@@ -1,0 +1,160 @@
+"""Device-mesh communication layer — the collectives the sharded
+engines ride (SURVEY.md §2.5/§5.8).
+
+Simulated-node message passing maps onto XLA collectives over the
+mesh's ICI — ``ppermute`` for fixed shift topologies (the token ring's
+neighbor exchange), ``lax.all_to_all`` for dynamic destinations —
+instead of the reference's TCP sockets
+(`/root/reference/src/Control/TimeWarp/Rpc/Transfer.hs:473,577`).
+
+:class:`MeshComm` substitutes mesh collectives behind the single-chip
+:class:`~timewarp_tpu.interp.jax_engine.common.LocalComm` interface so
+one superstep implementation serves both; :class:`ShardedDriver` is
+the shared ``shard_map`` run harness (state placement with
+``NamedSharding`` so XLA keeps every per-node array resident on its
+owning device across the whole loop, plus the jitted scan/while
+wrappers).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+from ..utils import jaxconfig  # noqa: F401
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..interp.jax_engine.common import LocalComm
+
+__all__ = ["Mesh", "MeshComm", "ShardedDriver", "make_mesh"]
+
+
+def make_mesh(n_devices: Optional[int] = None,
+              axis: str = "nodes") -> Mesh:
+    """A 1-D mesh over the first ``n_devices`` available devices."""
+    devs = jax.devices()
+    if n_devices is None:
+        n_devices = len(devs)
+    return Mesh(np.asarray(devs[:n_devices]), (axis,))
+
+
+class MeshComm(LocalComm):
+    """Mesh collectives behind the LocalComm interface; valid only
+    inside a ``shard_map`` body with ``axis`` bound."""
+
+    def __init__(self, axis: str, n_global: int, n_shards: int) -> None:
+        if n_global % n_shards:
+            raise ValueError(
+                f"n_nodes {n_global} not divisible by {n_shards} shards")
+        self.axis = axis
+        self.n_global = n_global
+        self.n_shards = n_shards
+        self.n_local = n_global // n_shards
+
+    def node_ids(self) -> jax.Array:
+        off = jax.lax.axis_index(self.axis).astype(jnp.int32) \
+            * jnp.int32(self.n_local)
+        return off + jnp.arange(self.n_local, dtype=jnp.int32)
+
+    def all_min(self, x: jax.Array) -> jax.Array:
+        # Not ``pmin``: the int64 min-all-reduce fails to lower on the
+        # TPU compiler path ("Supported lowering only of Sum all
+        # reduce"); gathering one scalar per device and reducing
+        # locally lowers everywhere and costs D words on ICI.
+        return jax.lax.all_gather(x, self.axis).min()
+
+    def all_sum(self, x: jax.Array) -> jax.Array:
+        return jax.lax.psum(x, self.axis)
+
+    def roll(self, x: jax.Array, s: int) -> jax.Array:
+        """Global roll by ``s`` along the last (node) axis: local roll +
+        boundary-slice ``ppermute`` to the next shard (and a whole-shard
+        ``ppermute`` when ``s`` spans shards). One ICI neighbor hop for
+        the ring's s=1."""
+        s = s % self.n_global
+        if s == 0:
+            return x
+        D, nl = self.n_shards, self.n_local
+        whole, rem = divmod(s, nl)
+        if whole:
+            perm = [(i, (i + whole) % D) for i in range(D)]
+            x = jax.lax.ppermute(x, self.axis, perm)
+        if rem:
+            tail = x[..., nl - rem:]
+            perm = [(i, (i + 1) % D) for i in range(D)]
+            recv = jax.lax.ppermute(tail, self.axis, perm)
+            x = jnp.concatenate([recv, x[..., :nl - rem]], axis=-1)
+        return x
+
+    def local_rows(self, table: np.ndarray) -> jax.Array:
+        off = jax.lax.axis_index(self.axis).astype(jnp.int32) \
+            * jnp.int32(self.n_local)
+        return jax.lax.dynamic_slice_in_dim(
+            jnp.asarray(table), off, self.n_local, axis=-1)
+
+
+class ShardedDriver:
+    """Shared ``shard_map`` driver for the sharded engines. The
+    concrete engine supplies ``_state_specs`` (its state's
+    PartitionSpecs, built from :meth:`_leaf_spec`), ``_superstep``, and
+    ``_next_event`` (the quiescence expression, inherited from its
+    local base class)."""
+
+    def _leaf_spec(self, x, last_axis: bool) -> P:
+        """PartitionSpec for one state leaf: the node axis (leading or
+        trailing per the engine's layout) sharded over the mesh axis,
+        everything else replicated; scalars fully replicated."""
+        ax = self.axis
+        nd = getattr(x, "ndim", 0)
+        if nd == 0:
+            return P()
+        if last_axis:
+            return P(*([None] * (nd - 1) + [ax]))
+        return P(ax, *([None] * (nd - 1)))
+
+    def init_state(self):
+        st = super().init_state()
+        specs = self._state_specs(st)
+        return jax.tree.map(
+            lambda x, s: jax.device_put(x, NamedSharding(self.mesh, s)),
+            st, specs)
+
+    @partial(jax.jit, static_argnums=(0, 2))
+    def _run_scan(self, st, max_steps: int):
+        specs = self._state_specs(st)
+
+        def body(s):
+            def step(carry, _):
+                return self._superstep(carry, True)
+            return jax.lax.scan(step, s, None, length=max_steps)
+
+        return jax.shard_map(
+            body, mesh=self.mesh, in_specs=(specs,),
+            out_specs=(specs, P()), check_vma=False)(st)
+
+    @partial(jax.jit, static_argnums=(0,))
+    def _run_while(self, st, max_steps):
+        from ..core.scenario import NEVER
+
+        specs = self._state_specs(st)
+        max_steps = jnp.asarray(max_steps, jnp.int64)
+
+        def body_fn(s, ms):
+            start_steps = s.steps
+
+            def cond(carry):
+                nxt = self.comm.all_min(self._next_event(carry))
+                return (nxt < NEVER) & (carry.steps - start_steps < ms)
+
+            def body(carry):
+                return self._superstep(carry, False)[0]
+
+            return jax.lax.while_loop(cond, body, s)
+
+        return jax.shard_map(
+            body_fn, mesh=self.mesh, in_specs=(specs, P()),
+            out_specs=specs, check_vma=False)(st, max_steps)
