@@ -123,3 +123,31 @@ def test_engine_resume_midway_matches_single_run():
     assert len(tr1) + len(tr2) == len(full_trace)
     assert int(st2.delivered) == int(full_state.delivered)
     assert int(st2.time) == int(full_state.time)
+
+
+def test_oracle_event_log_matches_trace_aggregates():
+    """record_events: the per-event debug stream's aggregates must
+    reproduce the trace rows exactly (SURVEY.md §5.1 — the detail
+    behind the digests)."""
+    from timewarp_tpu.models.token_ring import token_ring, token_ring_links
+
+    sc = token_ring(32, n_tokens=4, think_us=3_000, bootstrap_us=1000,
+                    end_us=150_000, with_observer=True, mailbox_cap=16)
+    link = token_ring_links(32)
+    oracle = SuperstepOracle(sc, link, record_events=True)
+    trace = oracle.run(2000)
+    ev = oracle.events
+    assert ev, "no events recorded"
+    by_kind = {}
+    for e in ev:
+        by_kind.setdefault(e[0], []).append(e)
+    assert len(by_kind["fire"]) == int(trace.fired_count.sum())
+    assert len(by_kind["recv"]) == trace.total_delivered()
+    assert len(by_kind["sent"]) == int(trace.sent_count.sum())
+    # events are in execution order: timestamps non-decreasing
+    ts = [e[1] for e in ev]
+    assert ts == sorted(ts)
+    # default stays off (no memory growth for normal runs)
+    o2 = SuperstepOracle(sc, link)
+    o2.run(50)
+    assert o2.events is None

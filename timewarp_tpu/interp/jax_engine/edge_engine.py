@@ -162,6 +162,8 @@ class EdgeState(NamedTuple):
     wake: jax.Array        # int64[N]
     q_rel: jax.Array       # int32[E, C, N] — deliver time minus `time`
     q_step: jax.Array      # int32[E, C, N] — insertion superstep
+    #                        (C is 0 for commutative_inbox scenarios:
+    #                        the table only feeds the contract-#2 sort)
     q_pay: jax.Array       # int32[E, C, P, N]
     q_valid: jax.Array     # bool[E, C, N]
     overflow: jax.Array    # int32[]
@@ -208,11 +210,16 @@ class EdgeEngine:
                 lambda *xs: jnp.stack([jnp.asarray(x) for x in xs]),
                 *[p[0] for p in per])
             wake = jnp.asarray([p[1] for p in per], jnp.int64)
+        # q_step orders same-deliver-time messages for the contract-#2
+        # sort; a commutative inbox never sorts, so carrying the table
+        # through the loop would be pure dead HBM traffic (~2 reads +
+        # writes of [E,C,N] int32 per superstep) — elide it to width 0
+        C_step = 0 if sc.commutative_inbox else C
         return EdgeState(
             states=states,
             wake=wake,
             q_rel=jnp.full((E, C, n), _I32MAX, jnp.int32),
-            q_step=jnp.zeros((E, C, n), jnp.int32),
+            q_step=jnp.zeros((E, C_step, n), jnp.int32),
             q_pay=jnp.zeros((E, C, P, n), jnp.int32),
             q_valid=jnp.zeros((E, C, n), bool),
             overflow=jnp.int32(0),
@@ -256,7 +263,8 @@ class EdgeEngine:
         #    reshape: no relayout)
         iv = deliver.reshape(W, n)
         rel = jnp.where(iv, st.q_rel.reshape(W, n), _I32MAX)
-        istep = st.q_step.reshape(W, n)
+        istep = None if sc.commutative_inbox \
+            else st.q_step.reshape(W, n)
         # per-edge sender ids: computable elementwise for shift edges
         # (works sharded); table lookup otherwise (local only)
         src_rows = jnp.stack([
@@ -379,8 +387,9 @@ class EdgeEngine:
             ins = ok[None, :] & (cids == ff)                 # [C, N]
             q_rel = q_rel.at[e].set(
                 jnp.where(ins, drel, q_rel[e]))
-            q_step = q_step.at[e].set(
-                jnp.where(ins, step32, q_step[e]))
+            if not sc.commutative_inbox:
+                q_step = q_step.at[e].set(
+                    jnp.where(ins, step32, q_step[e]))
             q_valid = q_valid.at[e].set(q_valid[e] | ins)
             q_pay = q_pay.at[e].set(
                 jnp.where(ins[:, None, :], arr_p[None, :, :], q_pay[e]))

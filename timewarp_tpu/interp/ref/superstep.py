@@ -47,10 +47,16 @@ class SuperstepOracle:
     """Sequential host executor; oracle for trace parity."""
 
     def __init__(self, scenario: Scenario, link: LinkModel, *,
-                 seed: int = 0) -> None:
+                 seed: int = 0, record_events: bool = False) -> None:
         self.scenario = scenario
         self.link = link
         self.s0, self.s1 = seed_words(seed)
+        #: optional per-event debug log (SURVEY.md §5.1): tuples
+        #: ("fire", t, node) / ("recv", t, node, src, deliver_t, pay0)
+        #: / ("sent", t, src, dst, deliver_t, pay0) in execution order —
+        #: the detail stream behind the aggregate digests, for
+        #: pinpointing a divergence the parity checker reports.
+        self.events: Optional[List[tuple]] = [] if record_events else None
         n = scenario.n_nodes
         per = [scenario.init(i) for i in range(n)]
         #: stacked numpy state pytree (row i = node i)
@@ -115,6 +121,8 @@ class SuperstepOracle:
             self.time = t
             fired = [i for i in range(n) if nexts[i] == t]
             fired_hash = combine_py(mix32_py(FIRED, i) for i in fired)
+            if self.events is not None:
+                self.events.extend(("fire", t, i) for i in fired)
 
             # build inboxes (host decision: contract #2 ordering)
             ib_valid = np.zeros((n, K), bool)
@@ -137,6 +145,10 @@ class SuperstepOracle:
                     recv_hashes.append(mix32_py(
                         RECV, i, m[1], m[0] & _MASK32, m[0] >> 32,
                         int(m[2][0]) if P else 0))
+                    if self.events is not None:
+                        self.events.append(
+                            ("recv", t, i, int(m[1]), int(m[0]),
+                             int(m[2][0]) if P else 0))
                 recv_count += len(picked)
 
             inbox = Inbox(valid=ib_valid, src=ib_src, time=ib_time,
@@ -183,6 +195,8 @@ class SuperstepOracle:
                     sent_count += 1
                     sent_hashes.append(mix32_py(
                         SENT, i, dst, dt & _MASK32, dt >> 32, p0))
+                    if self.events is not None:
+                        self.events.append(("sent", t, i, dst, dt, p0))
                     if len(self.mailbox[dst]) >= K:
                         overflow_step += 1  # contract #6: counted, dropped
                     else:
