@@ -1,0 +1,33 @@
+"""Every bench.py config must run end-to-end at tiny scale — the
+driver executes bench.py at round end, so a rotted config means a
+missing headline number."""
+
+import json
+
+import pytest
+
+import bench
+
+
+@pytest.mark.parametrize("cfg", sorted(bench.CONFIGS))
+def test_bench_config_runs(cfg):
+    n = {"token_ring_dense": 512, "token_ring_observer": 256,
+         "gossip_100k": 512, "gossip_steady_1m": 512,
+         "praos_1m": 512}[cfg]
+    # gossip_100k runs one wave to quiescence and asserts it got there
+    steps = 20_000 if cfg == "gossip_100k" else 48
+    metric, rate = bench.CONFIGS[cfg](n, steps)
+    assert rate > 0
+    assert str(n) in metric
+
+
+def test_bench_main_prints_one_json_line(capsys, monkeypatch):
+    monkeypatch.setenv("TW_BENCH_CONFIG", "token_ring_dense")
+    monkeypatch.setenv("TW_BENCH_NODES", "256")
+    monkeypatch.setenv("TW_BENCH_STEPS", "32")
+    bench.main()
+    out = capsys.readouterr().out.strip().splitlines()
+    assert len(out) == 1
+    row = json.loads(out[0])
+    assert set(row) == {"metric", "value", "unit", "vs_baseline"}
+    assert row["unit"] == "msg/s"

@@ -40,15 +40,31 @@ def praos(n: int, *,
           slot_us: Microsecond = sec(1),
           n_slots: int = 20,
           leader_prob: float = 0.05,
+          stake=None,
           fanout: int = 8,
           relay_interval: Microsecond = ms(2),
           mailbox_cap: int = 16) -> Scenario:
     """Build the Praos scenario. Quiesces after ``n_slots`` slots once
     the last relay bursts drain. ``leader_prob`` is the per-slot
-    per-node leadership probability (the aggregate block rate is
-    ``n * leader_prob`` per slot — keep it ≲ a few for realistic
-    fork behavior at scale)."""
-    thr = min(int(leader_prob * 4294967296.0), 2**32 - 1)
+    per-node leadership probability at stake weight 1 (the aggregate
+    block rate is ``sum(stake) * leader_prob`` per slot — keep it ≲ a
+    few for realistic fork behavior at scale). ``stake`` (optional
+    int array [n]) weights each node's leadership linearly — the
+    "stake nodes" of the baseline config; None = equal stake 1."""
+    import numpy as _np
+
+    if stake is None:
+        thr_arr = _np.full(
+            n, min(int(leader_prob * 4294967296.0), 2**32 - 1),
+            _np.uint32)
+    else:
+        stake = _np.asarray(stake)
+        if stake.shape != (n,) or (stake < 0).any():
+            raise ValueError("stake must be a non-negative int array [n]")
+        thr_arr = _np.minimum(
+            stake.astype(_np.float64) * leader_prob * 4294967296.0,
+            2**32 - 1).astype(_np.uint32)
+    thr_j = jnp.asarray(thr_arr)
 
     def step(state, inbox: Inbox, now, i, key):
         best, lcg = state["best"], state["lcg"]
@@ -61,10 +77,11 @@ def praos(n: int, *,
         adopt = tin > best
         best1 = jnp.where(adopt, tin, best)
 
-        # slot boundary: private leadership draw from the firing entropy
+        # slot boundary: private stake-weighted leadership draw from
+        # the firing entropy (≙ the VRF threshold check)
         due_slot = (slot < jnp.int32(n_slots)) & (nslot <= now)
         b0, _ = key
-        leader = due_slot & (b0 < jnp.uint32(thr))
+        leader = due_slot & (b0 < thr_j[i])
         best2 = best1 + leader.astype(jnp.int32)
         slot1 = slot + due_slot.astype(jnp.int32)
         nslot1 = jnp.where(due_slot, nslot + jnp.int64(slot_us), nslot)
