@@ -98,12 +98,11 @@ def bench_gossip_100k(n, steps):
     delivered, dt, fin = _measure(engine, steps or (1 << 20))
     # genuine quiescence, not a window or deadline artifact: no events
     # pending, and the epidemic actually covered the whole network
-    import jax as _jax
-    import numpy as _np
+    import numpy as np
     from timewarp_tpu.core.scenario import NEVER
     assert int(engine._next_event(fin)) >= NEVER, \
         "broadcast did not quiesce inside the step budget"
-    hops = _np.asarray(_jax.device_get(fin.states["hop"]))
+    hops = np.asarray(jax.device_get(fin.states["hop"]))
     assert (hops >= 0).all(), \
         f"wave truncated: {(hops < 0).sum()} nodes never infected"
     return (f"gossip broadcast wave to quiescence (lognormal links) "

@@ -102,3 +102,16 @@ def test_duplicate_wire_name_rejected():
         @message(name="UniqueWireNameX")
         class B:
             y: int
+
+
+def test_summarize_stats():
+    from timewarp_tpu.bench_net.log_reader import summarize
+
+    table = launch(msgs=50, threads=5, duration_s=5, delay_us=2_000,
+                   seed=1)
+    s = summarize(table)
+    assert s["messages"] == 50 and s["complete_timelines"] == 50
+    # emulated fixed links: RTT is exactly two hops + queueing
+    assert 4_000 <= s["rtt_us"]["p50"] <= 6_000
+    assert s["rtt_us"]["p50"] <= s["rtt_us"]["p90"] <= s["rtt_us"]["p99"]
+    assert s["one_way_us"]["p50"] >= 2_000

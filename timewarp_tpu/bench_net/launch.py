@@ -20,7 +20,7 @@ from typing import List
 
 from ..core.effects import Program, fork_, modify_log_name
 from ..utils.logconfig import configure_logging
-from .log_reader import join_measures, write_csv
+from .log_reader import join_measures, summarize, write_csv
 from .receiver import receiver
 from .sender import sender
 
@@ -149,6 +149,8 @@ def main(argv=None) -> int:
     p.add_argument("--logs-dir", default=None,
                    help="also write raw sender.log / receiver.log here")
     p.add_argument("--out", default="measures.csv")
+    p.add_argument("--stats", action="store_true",
+                   help="also print an RTT/throughput summary JSON line")
     a = p.parse_args(argv)
 
     table = launch(
@@ -160,6 +162,9 @@ def main(argv=None) -> int:
     complete = sum(1 for k, v in table.items()
                    if isinstance(k, int) and len(v) == 5)
     print(f"{a.out}: {n} message timelines ({complete} complete)")
+    if a.stats:
+        import json as _json
+        print(_json.dumps(summarize(table)))
     return 0
 
 

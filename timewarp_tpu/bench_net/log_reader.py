@@ -12,7 +12,7 @@ from typing import Dict, Iterable, List
 
 from .commons import MeasureEvent, parse_measure_line
 
-__all__ = ["join_measures", "write_csv", "read_log_lines"]
+__all__ = ["join_measures", "write_csv", "read_log_lines", "summarize"]
 
 _COLS = [MeasureEvent.PING_SENT, MeasureEvent.PING_RECEIVED,
          MeasureEvent.PONG_SENT, MeasureEvent.PONG_RECEIVED]
@@ -61,3 +61,46 @@ def write_csv(table: Dict[int, dict], path: str) -> int:
                        [row.get(c, "") for c in _COLS])
             n += 1
     return n
+
+
+def summarize(table: Dict[int, dict]) -> dict:
+    """Aggregate the joined 4-point timelines: message counts, RTT
+    (PingSent -> PongReceived) percentiles, one-way (PingSent ->
+    PingReceived) percentiles, and throughput over the sending window —
+    the numbers the reference computed by hand in its spreadsheet
+    (bench/calc-template.ods)."""
+    rows = [v for k, v in table.items() if isinstance(k, int)]
+    complete = [r for r in rows
+                if MeasureEvent.PING_SENT in r
+                and MeasureEvent.PONG_RECEIVED in r]
+    one_way = [r for r in rows
+               if MeasureEvent.PING_SENT in r
+               and MeasureEvent.PING_RECEIVED in r]
+
+    def pct(xs, q):
+        if not xs:
+            return None
+        xs = sorted(xs)
+        # nearest-rank percentile: ceil(q*n)-1, not int(q*n) (which
+        # selects one rank high and degenerates at small n)
+        import math
+        return xs[max(0, min(len(xs) - 1, math.ceil(q * len(xs)) - 1))]
+
+    rtts = [r[MeasureEvent.PONG_RECEIVED] - r[MeasureEvent.PING_SENT]
+            for r in complete]
+    ows = [r[MeasureEvent.PING_RECEIVED] - r[MeasureEvent.PING_SENT]
+           for r in one_way]
+    sends = [r[MeasureEvent.PING_SENT] for r in rows
+             if MeasureEvent.PING_SENT in r]
+    window_us = (max(sends) - min(sends)) if len(sends) > 1 else 0
+    return {
+        "messages": len(rows),
+        "complete_timelines": len(complete),
+        "send_window_us": window_us,
+        "send_rate_msg_s": (round(len(sends) / (window_us / 1e6), 1)
+                            if window_us else None),
+        "rtt_us": {"p50": pct(rtts, 0.50), "p90": pct(rtts, 0.90),
+                   "p99": pct(rtts, 0.99), "max": max(rtts, default=None)},
+        "one_way_us": {"p50": pct(ows, 0.50), "p90": pct(ows, 0.90),
+                       "p99": pct(ows, 0.99)},
+    }
