@@ -151,8 +151,15 @@ class Rpc:
         arrives on the same connection (≙ ``call``,
         MonadRpc.hs.unused:50-51). Raises the request's expected error
         if the handler raised it, :class:`RpcError` on unexpected
-        failures. Compose with :func:`timewarp_tpu.core.effects.timeout`
-        for deadlines."""
+        failures.
+
+        Delivery contract (same as the reference's): the transport
+        re-sends the *request* through reconnects, but a *reply* whose
+        inbound connection reset is lost — a call can then block
+        forever. Compose with
+        :func:`timewarp_tpu.core.effects.timeout` and retry for
+        at-least-once semantics over lossy links
+        (tests/test_rpc.py::test_calls_survive_connection_resets)."""
         if getattr(type(req), "__rpc_response__", None) is None:
             raise TypeError(f"{type(req)!r} is not declared with "
                             "@request(response=...)")
