@@ -322,3 +322,31 @@ def test_general_sharded_resume_parity():
         np.concatenate([first.times, rest.times]), full.times)
     assert np.array_equal(
         np.concatenate([first.recv_hash, rest.recv_hash]), full.recv_hash)
+
+
+def test_two_axis_mesh_dcn_ici():
+    """Multi-slice deployment shape: a (2, 4) mesh named (dcn, ici)
+    with the node axis sharded over the flattened product. Both the
+    ppermute ring (edge engine) and the all_to_all exchange (general
+    engine) must reproduce the 1-device traces bit-for-bit across the
+    two-axis mesh."""
+    from timewarp_tpu.interp.jax_engine.engine import JaxEngine
+    from timewarp_tpu.interp.jax_engine.sharded import ShardedEngine
+    from timewarp_tpu.models.gossip import gossip
+
+    mesh2 = make_mesh(shape=(2, 4), axes=("dcn", "ici"))
+    ax = ("dcn", "ici")
+
+    sc = token_ring(64, n_tokens=16, think_us=1_000, bootstrap_us=1000,
+                    end_us=120_000, with_observer=False, mailbox_cap=4)
+    link = UniformDelay(300, 1_200)
+    _, lt = EdgeEngine(sc, link).run(250)
+    _, st = ShardedEdgeEngine(sc, link, mesh2, axis=ax).run(250)
+    assert_traces_equal(lt, st, "1-device", "2x4-mesh")
+
+    sc2 = gossip(64, fanout=4, think_us=2_000, gossip_interval=1_000,
+                 end_us=300_000, mailbox_cap=8)
+    _, glt = JaxEngine(sc2, link).run(250)
+    _, gst = ShardedEngine(sc2, link, mesh2, axis=ax).run(250)
+    assert_traces_equal(glt, gst, "1-device", "2x4-mesh-all2all",
+                        limit=len(gst))

@@ -30,7 +30,8 @@ from jax.sharding import PartitionSpec as P
 
 from ...core.scenario import Scenario
 from ...net.delays import LinkModel
-from ...parallel.mesh import Mesh, MeshComm, ShardedDriver, make_mesh
+from ...parallel.mesh import (AxisName, Mesh, MeshComm,
+                              ShardedDriver, axis_size, make_mesh)
 from .common import group_rank
 from .edge_engine import EdgeEngine, EdgeState
 from .engine import EngineState, JaxEngine
@@ -43,7 +44,7 @@ class ShardedEdgeEngine(ShardedDriver, EdgeEngine):
     ``ppermute``. Same ``run`` / ``run_quiet`` API as the local engine."""
 
     def __init__(self, scenario: Scenario, link: LinkModel,
-                 mesh: Mesh, *, axis: str = "nodes", seed: int = 0,
+                 mesh: Mesh, *, axis: AxisName = "nodes", seed: int = 0,
                  cap: int = 2) -> None:
         super().__init__(scenario, link, seed=seed, cap=cap)
         bad = [e for e, s in enumerate(self.topo.shift) if s is None]
@@ -54,7 +55,7 @@ class ShardedEdgeEngine(ShardedDriver, EdgeEngine):
                 "topologies need the all_to_all general sharded engine")
         self.mesh = mesh
         self.axis = axis
-        D = mesh.shape[axis]
+        D = axis_size(mesh, axis)
         self.comm = MeshComm(axis, scenario.n_nodes, D)
 
     # -- sharding specs --------------------------------------------------
@@ -92,12 +93,12 @@ class ShardedEngine(ShardedDriver, JaxEngine):
     """
 
     def __init__(self, scenario: Scenario, link: LinkModel,
-                 mesh: Mesh, *, axis: str = "nodes", seed: int = 0,
+                 mesh: Mesh, *, axis: AxisName = "nodes", seed: int = 0,
                  bucket_cap: Optional[int] = None) -> None:
         super().__init__(scenario, link, seed=seed)
         self.mesh = mesh
         self.axis = axis
-        D = mesh.shape[axis]
+        D = axis_size(mesh, axis)
         self.comm = MeshComm(axis, scenario.n_nodes, D)
         full = self.comm.n_local * scenario.max_out
         self.bucket_cap = full if bucket_cap is None else min(

@@ -19,7 +19,7 @@ wrappers).
 from __future__ import annotations
 
 from functools import partial
-from typing import Optional
+from typing import Optional, Tuple, Union
 
 from ..utils import jaxconfig  # noqa: F401
 
@@ -30,23 +30,56 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..interp.jax_engine.common import LocalComm
 
-__all__ = ["Mesh", "MeshComm", "ShardedDriver", "make_mesh"]
+__all__ = ["AxisName", "Mesh", "MeshComm", "ShardedDriver", "axis_size",
+           "make_mesh"]
+
+#: a mesh axis: one name, or a tuple of names whose row-major product
+#: the collectives flatten over (multi-slice meshes)
+AxisName = Union[str, Tuple[str, ...]]
 
 
 def make_mesh(n_devices: Optional[int] = None,
-              axis: str = "nodes") -> Mesh:
-    """A 1-D mesh over the first ``n_devices`` available devices."""
+              axis: str = "nodes", *,
+              shape: Optional[tuple] = None,
+              axes: Optional[tuple] = None) -> Mesh:
+    """A 1-D mesh over the first ``n_devices`` devices, or — with
+    ``shape``/``axes`` — a multi-axis mesh, e.g.
+    ``make_mesh(shape=(2, 4), axes=("dcn", "ici"))`` for a two-slice
+    deployment. The engines accept the axis-name *tuple* wherever they
+    take an axis: every collective (psum / all_gather / ppermute /
+    all_to_all) runs over the flattened row-major product, so the same
+    boundary-slice ring and destination-shard exchange span slices —
+    lay the minor axis over ICI so the high-traffic neighbor hops stay
+    intra-slice."""
     devs = jax.devices()
+    if shape is not None:
+        n = int(np.prod(shape))
+        if axes is None or len(axes) != len(shape):
+            raise ValueError("axes must name every mesh dimension")
+        if len(devs) < n:
+            raise ValueError(
+                f"mesh shape {shape} needs {n} devices, have {len(devs)}")
+        return Mesh(np.asarray(devs[:n]).reshape(shape), tuple(axes))
+    if axes is not None:
+        raise ValueError("axes= requires shape=")
     if n_devices is None:
         n_devices = len(devs)
     return Mesh(np.asarray(devs[:n_devices]), (axis,))
+
+
+def axis_size(mesh: Mesh, axis: AxisName) -> int:
+    """Total device count of ``axis`` (a name or a tuple of names)."""
+    if isinstance(axis, tuple):
+        return int(np.prod([mesh.shape[a] for a in axis]))
+    return mesh.shape[axis]
 
 
 class MeshComm(LocalComm):
     """Mesh collectives behind the LocalComm interface; valid only
     inside a ``shard_map`` body with ``axis`` bound."""
 
-    def __init__(self, axis: str, n_global: int, n_shards: int) -> None:
+    def __init__(self, axis: AxisName, n_global: int,
+                 n_shards: int) -> None:
         if n_global % n_shards:
             raise ValueError(
                 f"n_nodes {n_global} not divisible by {n_shards} shards")
