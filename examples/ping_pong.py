@@ -1,0 +1,36 @@
+"""Runnable ping-pong example (≙ the reference's `examples/ping-pong`):
+two nodes over the full dialog/transport stack, emulated by default.
+
+    python examples/ping_pong.py            # deterministic emulation
+    python examples/ping_pong.py --real     # wall-clock + real TCP
+"""
+
+import argparse
+import sys
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+from timewarp_tpu.interp.aio.timed import run_real_time
+from timewarp_tpu.interp.ref.des import run_emulation
+from timewarp_tpu.models.ping_pong_net import ping_pong_net
+from timewarp_tpu.net.backend import AioBackend, EmulatedBackend
+from timewarp_tpu.net.delays import UniformDelay
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--real", action="store_true")
+    p.add_argument("--seed", type=int, default=0)
+    a = p.parse_args()
+    if a.real:
+        times = run_real_time(ping_pong_net(
+            AioBackend(), pong_host="127.0.0.1", warmup_us=50_000))
+    else:
+        net = EmulatedBackend(UniformDelay(1_000, 5_000), seed=a.seed)
+        times = run_emulation(ping_pong_net(net))
+    for what, t in sorted(times.items(), key=lambda kv: kv[1]):
+        print(f"{t:>10} µs  {what}")
+
+
+if __name__ == "__main__":
+    main()

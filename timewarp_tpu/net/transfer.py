@@ -340,6 +340,11 @@ class Transport:
                         raise
                     except GeneratorExit:   # teardown must unwind
                         raise
+                    except PeerClosedConnection:
+                        # a client hanging up cleanly is the ordinary
+                        # end of a connection, not an error
+                        _log.info("happily closing input connection "
+                                  "%d <- %s (peer closed)", port, peer)
                     except BaseException as e:  # noqa: BLE001
                         lvl = (logging.DEBUG if sf.curator.is_closed
                                else logging.WARNING)
