@@ -6,9 +6,11 @@ two nodes over the full dialog/transport stack, emulated by default.
 """
 
 import argparse
+import os
 import sys
 
-sys.path.insert(0, __file__.rsplit("/", 2)[0])
+sys.path.insert(0, os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
 
 from timewarp_tpu.interp.aio.timed import run_real_time
 from timewarp_tpu.interp.ref.des import run_emulation

@@ -8,9 +8,11 @@ via per-socket user state; roulette clients; optional nastiness.
 """
 
 import argparse
+import os
 import sys
 
-sys.path.insert(0, __file__.rsplit("/", 2)[0])
+sys.path.insert(0, os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
 
 from timewarp_tpu.interp.aio.timed import run_real_time
 from timewarp_tpu.interp.ref.des import run_emulation
@@ -25,6 +27,9 @@ def main() -> None:
     p.add_argument("--drop", type=float, default=0.0)
     p.add_argument("--seed", type=int, default=6)
     a = p.parse_args()
+    if a.real and a.drop:
+        p.error("--drop injects loss into the emulated fabric; "
+                "it cannot apply to real TCP (drop --real or --drop)")
     if a.real:
         res = run_real_time(socket_state_net(
             AioBackend(), server_host="127.0.0.1", server_port=34441,

@@ -10,9 +10,11 @@ call/serve with an observer checking monotonic progress — one
 """
 
 import argparse
+import os
 import sys
 
-sys.path.insert(0, __file__.rsplit("/", 2)[0])
+sys.path.insert(0, os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
 
 
 def main() -> None:

@@ -250,3 +250,23 @@ def test_huge_delay_clamped_and_counted():
     eng = EdgeEngine(sc, FixedDelay(3_000_000_000), cap=2)  # 50 min
     st, _ = eng.run(40)
     assert int(st.bad_delay) > 0
+
+
+def test_local_run_quiet_matches_traced_run():
+    """The local edge engine's while_loop driver (the bench path) must
+    agree with its traced scan driver."""
+    import jax
+
+    sc = token_ring(32, n_tokens=8, think_us=1_000, bootstrap_us=1000,
+                    end_us=150_000, with_observer=False, mailbox_cap=4)
+    link = UniformDelay(200, 900)
+    eng = EdgeEngine(sc, link)
+    traced_final, _ = eng.run(400)
+    quiet_final = eng.run_quiet(400)
+    for name in ("delivered", "steps", "time", "overflow"):
+        assert int(getattr(traced_final, name)) == \
+            int(getattr(quiet_final, name)), name
+    for k in traced_final.states:
+        assert np.array_equal(
+            np.asarray(jax.device_get(traced_final.states[k])),
+            np.asarray(jax.device_get(quiet_final.states[k]))), k

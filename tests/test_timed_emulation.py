@@ -372,3 +372,20 @@ def test_wait_costs_zero_wallclock():
     result = run_emulation(prog)
     assert result == 600 * 1_000_000
     assert _wall.monotonic() - t0 < 1.0  # instant in wall-clock
+
+
+def test_variadic_time_accumulators():
+    """≙ the reference's TimeAcc DSL (`wait for 1 minute 30 sec`,
+    MonadTimed.hs:351-376): specs accept multiple units summed."""
+    from timewarp_tpu.core.time import at, for_, minute, ms, sec
+
+    def prog():
+        yield Wait(for_(minute(1), sec(30)))
+        t1 = yield GetTime()
+        yield Wait(at(minute(2), sec(2), ms(500)))
+        t2 = yield GetTime()
+        return t1, t2
+
+    t1, t2 = run_emulation(prog)
+    assert t1 == 90_000_000
+    assert t2 == 122_500_000

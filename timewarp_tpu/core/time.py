@@ -61,27 +61,32 @@ def hour(n: Duration) -> Microsecond:
 
 # Time specs -------------------------------------------------------------
 
-def for_(t: Microsecond) -> RelativeToNow:
-    """Relative spec: fire ``t`` microseconds after now (MonadTimed.hs:286-290)."""
-    t = int(t)
-    return lambda cur: cur + t
+def for_(t: Microsecond, *ts: Microsecond) -> RelativeToNow:
+    """Relative spec: fire ``t + sum(ts)`` microseconds after now
+    (MonadTimed.hs:286-290). Variadic like the reference's time
+    accumulators (``for 1 minute 30 sec`` — MonadTimed.hs:351-376):
+    ``for_(minute(1), sec(30))``. At least one duration is required —
+    a zero-argument call is a bug, not a zero wait."""
+    total = int(t) + sum(int(x) for x in ts)
+    return lambda cur: cur + total
 
 
-def after(t: Microsecond) -> RelativeToNow:
+def after(t: Microsecond, *ts: Microsecond) -> RelativeToNow:
     """Synonym of :func:`for_`, reads better with schedule/invoke
     (MonadTimed.hs:291-292)."""
-    return for_(t)
+    return for_(t, *ts)
 
 
-def till(t: Microsecond) -> RelativeToNow:
-    """Absolute spec: fire at virtual time ``t`` (MonadTimed.hs:278-282)."""
-    t = int(t)
-    return lambda _cur: t
+def till(t: Microsecond, *ts: Microsecond) -> RelativeToNow:
+    """Absolute spec: fire at virtual time ``t + sum(ts)``
+    (MonadTimed.hs:278-282; variadic accumulator like :func:`for_`)."""
+    total = int(t) + sum(int(x) for x in ts)
+    return lambda _cur: total
 
 
-def at(t: Microsecond) -> RelativeToNow:
+def at(t: Microsecond, *ts: Microsecond) -> RelativeToNow:
     """Synonym of :func:`till` (MonadTimed.hs:283-284)."""
-    return till(t)
+    return till(t, *ts)
 
 
 def now(cur: Microsecond) -> Microsecond:
