@@ -67,10 +67,12 @@ class EngineState(NamedTuple):
     """
     states: Any        # scenario pytree, leading dim N
     wake: jax.Array    # int64[N]
-    mb_rel: jax.Array      # int32[K, N] — deliver time minus `time`
+    #: int32[K, N] deliver time minus `time`; I32MAX = empty slot (real
+    #: entries clamp to I32MAX-1), so validity is derived, never stored
+    #: or scattered
+    mb_rel: jax.Array
     mb_src: jax.Array      # int32[K, N]
     mb_payload: jax.Array  # int32[K, P, N]
-    mb_valid: jax.Array    # bool[K, N]
     overflow: jax.Array    # int32[] — total overflowed messages
     bad_dst: jax.Array     # int32[] — total messages to invalid destinations
     bad_delay: jax.Array   # int32[] — delays >= 2^31 µs, clamped
@@ -119,7 +121,6 @@ class JaxEngine:
             mb_rel=jnp.full((K, n), _I32MAX, jnp.int32),
             mb_src=jnp.zeros((K, n), jnp.int32),
             mb_payload=jnp.zeros((K, P, n), jnp.int32),
-            mb_valid=jnp.zeros((K, n), bool),
             overflow=jnp.int32(0),
             bad_dst=jnp.int32(0),
             bad_delay=jnp.int32(0),
@@ -153,9 +154,11 @@ class JaxEngine:
         node_ids = comm.node_ids()  # global identities, int32[n]
         base = st.time
 
+        # validity is the rel sentinel (I32MAX = empty slot)
+        mb_live = st.mb_rel < _I32MAX                           # [K, N]
+
         # 1. global next event time (the batched "pop min", TimedT.hs:241-245)
-        mb_eff = jnp.where(st.mb_valid, st.mb_rel, _I32MAX)     # [K, N]
-        nnr = mb_eff.min(axis=0)
+        nnr = st.mb_rel.min(axis=0)
         node_next = jnp.minimum(
             st.wake,
             jnp.where(nnr == _I32MAX, jnp.int64(NEVER),
@@ -167,7 +170,7 @@ class JaxEngine:
                               jnp.int64(_I32MAX - 1)).astype(jnp.int32)
 
         # 2. deliverable messages, per firing node
-        deliver = st.mb_valid & (st.mb_rel <= shift32) & fire[None, :]
+        deliver = mb_live & (st.mb_rel <= shift32) & fire[None, :]
 
         # 3. inbox: delivered slots first, ordered by (time, arrival slot)
         #    (determinism contract #2) — one variadic sort along K
@@ -213,16 +216,16 @@ class JaxEngine:
 
         # 5. compact mailboxes: drop delivered, keep arrival order,
         #    rebase surviving deliver-times to the new epoch t
-        keep = st.mb_valid & ~deliver
+        keep = mb_live & ~deliver
         ops2 = jax.lax.sort(
             (~keep, slots, st.mb_rel, st.mb_src) + tuple(
                 st.mb_payload[:, p, :] for p in range(P)),
             dimension=0, num_keys=2)
-        mb_valid = ~ops2[0]
-        mb_rel = jnp.where(mb_valid, ops2[2] - shift32, _I32MAX)
+        kept = ~ops2[0]
+        mb_rel = jnp.where(kept, ops2[2] - shift32, _I32MAX)
         mb_src = ops2[3]
         mb_payload = jnp.stack(ops2[4:4 + P], axis=1)
-        counts = mb_valid.sum(axis=0, dtype=jnp.int32)          # [N]
+        counts = kept.sum(axis=0, dtype=jnp.int32)              # [N]
 
         # 6. route outboxes; arrival order is fixed later by the global
         #    sender-major rank key, so the flatten order is free
@@ -273,7 +276,6 @@ class JaxEngine:
         for p in range(P):
             mb_payload = mb_payload.at[col, p, row].set(
                 ops3[5 + p], mode="drop")
-        mb_valid = mb_valid.at[col, row].set(fits, mode="drop")
         overflow_step = comm.all_sum(
             jnp.sum(ok_s & (pos >= K), dtype=jnp.int32)) + bucket_ovf
 
@@ -281,7 +283,6 @@ class JaxEngine:
         new_st = EngineState(
             states=states, wake=wake,
             mb_rel=mb_rel, mb_src=mb_src, mb_payload=mb_payload,
-            mb_valid=mb_valid,
             overflow=st.overflow + overflow_step,
             bad_dst=st.bad_dst + bad_dst_step,
             bad_delay=st.bad_delay + bad_delay_step,
@@ -352,7 +353,7 @@ class JaxEngine:
     def _next_event(self, carry: EngineState) -> jax.Array:
         """This device's next event time (NEVER = quiesced) — the
         while-loop condition shared by the local and sharded drivers."""
-        mmin = jnp.where(carry.mb_valid, carry.mb_rel, _I32MAX).min()
+        mmin = carry.mb_rel.min()
         return jnp.minimum(
             carry.wake.min(),
             jnp.where(mmin == _I32MAX, jnp.int64(NEVER),

@@ -158,7 +158,6 @@ class ShardedEngine(ShardedDriver, JaxEngine):
             mb_rel=leaf(st.mb_rel, True),
             mb_src=leaf(st.mb_src, True),
             mb_payload=leaf(st.mb_payload, True),
-            mb_valid=leaf(st.mb_valid, True),
             overflow=P(), bad_dst=P(), bad_delay=P(),
             delivered=P(), steps=P(), time=P(),
         )
