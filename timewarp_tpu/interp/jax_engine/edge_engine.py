@@ -1,10 +1,10 @@
 """Edge engine: sort/scatter-free batched execution for static topologies.
 
-The general engine (engine.py) routes messages with a global
-stable-argsort + searchsorted + 4 mailbox scatters per superstep; on
-TPU those are the entire cost (profiling/superstep_breakdown.md:
-random scatter ≈ 1 ms/131k updates, int64 scatter ≈ 15 ms, while
-elementwise/sort work is ~free). When the communication graph is
+The general engine (engine.py) routes messages with one variadic sort
+plus 2+P mailbox scatters per superstep; on TPU scatters are the
+dominant cost (profiling/superstep_breakdown.md: random scatter
+≈ 1 ms/131k updates, int64 scatter ≈ 15 ms, while elementwise/sort
+work is ~free). When the communication graph is
 *static* — every outbox slot always targets the same destination
 (``Scenario.static_dst``) — routing needs none of that:
 
@@ -160,12 +160,13 @@ class EdgeState(NamedTuple):
     shardable. Queue axes: [E edges, C capacity, N nodes]."""
     states: Any            # scenario pytree, leading dim N
     wake: jax.Array        # int64[N]
-    q_rel: jax.Array       # int32[E, C, N] — deliver time minus `time`
+    #: int32[E, C, N] deliver time minus `time`; I32MAX = empty slot
+    #: (real delays clamp to I32MAX-1), so validity is derived
+    q_rel: jax.Array
     q_step: jax.Array      # int32[E, C, N] — insertion superstep
     #                        (C is 0 for commutative_inbox scenarios:
     #                        the table only feeds the contract-#2 sort)
     q_pay: jax.Array       # int32[E, C, P, N]
-    q_valid: jax.Array     # bool[E, C, N]
     overflow: jax.Array    # int32[]
     unrouted: jax.Array    # int32[] — valid sends on undeclared slots
     misrouted: jax.Array   # int32[] — out.dst disagreeing with static_dst
@@ -221,7 +222,6 @@ class EdgeEngine:
             q_rel=jnp.full((E, C, n), _I32MAX, jnp.int32),
             q_step=jnp.zeros((E, C_step, n), jnp.int32),
             q_pay=jnp.zeros((E, C, P, n), jnp.int32),
-            q_valid=jnp.zeros((E, C, n), bool),
             overflow=jnp.int32(0),
             unrouted=jnp.int32(0),
             misrouted=jnp.int32(0),
@@ -243,9 +243,11 @@ class EdgeEngine:
         node_ids = comm.node_ids()  # global identities, int32[n]
         base = st.time
 
+        # validity is the rel sentinel (I32MAX = empty slot)
+        q_live = st.q_rel < _I32MAX                          # [E,C,N]
+
         # 1. global next event time (the batched "pop min")
-        qeff = jnp.where(st.q_valid, st.q_rel, _I32MAX)     # [E,C,N]
-        nnr = qeff.min(axis=(0, 1))                          # int32[N]
+        nnr = st.q_rel.min(axis=(0, 1))                          # int32[N]
         node_next = jnp.minimum(
             st.wake,
             jnp.where(nnr == _I32MAX, jnp.int64(NEVER),
@@ -257,7 +259,7 @@ class EdgeEngine:
         # 2. deliverable messages (all per-edge slots due at fired nodes)
         shift32 = jnp.minimum(t - base,
                               jnp.int64(_I32MAX - 1)).astype(jnp.int32)
-        deliver = st.q_valid & (st.q_rel <= shift32) & fire[None, None, :]
+        deliver = q_live & (st.q_rel <= shift32) & fire[None, None, :]
 
         # 3. inbox [W, N] — slot-axis views of the queues (leading-axis
         #    reshape: no relayout)
@@ -334,11 +336,10 @@ class EdgeEngine:
             out_valid & declared & (out.dst != sd_local), dtype=jnp.int32)
 
         # 5. rebase surviving queue entries to the new epoch t
-        keep = st.q_valid & ~deliver
+        keep = q_live & ~deliver
         q_rel = jnp.where(keep, st.q_rel - shift32, _I32MAX)
         q_step = st.q_step
         q_pay = st.q_pay
-        q_valid = keep
 
         # 6-7. route + enqueue, one static in-edge at a time — gathers
         # only on non-shift edges, never a scatter
@@ -381,7 +382,7 @@ class EdgeEngine:
                 sent_hash = sent_hash + _u32sum(jnp.where(ok, smix, 0))
                 sent_count = sent_count + jnp.sum(ok, dtype=jnp.int32)
             # first-free-slot one-hot insert over the static C axis
-            free = ~q_valid[e]                               # [C, N]
+            free = q_rel[e] == _I32MAX                       # [C, N]
             cids = jnp.arange(C, dtype=jnp.int32)[:, None]
             ff = jnp.where(free, cids, C).min(axis=0)        # int32[N]
             ins = ok[None, :] & (cids == ff)                 # [C, N]
@@ -390,7 +391,6 @@ class EdgeEngine:
             if not sc.commutative_inbox:
                 q_step = q_step.at[e].set(
                     jnp.where(ins, step32, q_step[e]))
-            q_valid = q_valid.at[e].set(q_valid[e] | ins)
             q_pay = q_pay.at[e].set(
                 jnp.where(ins[:, None, :], arr_p[None, :, :], q_pay[e]))
             overflow_step = overflow_step + jnp.sum(
@@ -400,7 +400,7 @@ class EdgeEngine:
         overflow_step = comm.all_sum(overflow_step)
         new_st = EdgeState(
             states=states, wake=wake,
-            q_rel=q_rel, q_step=q_step, q_pay=q_pay, q_valid=q_valid,
+            q_rel=q_rel, q_step=q_step, q_pay=q_pay,
             overflow=st.overflow + overflow_step,
             unrouted=st.unrouted + comm.all_sum(unrouted_step),
             misrouted=st.misrouted + comm.all_sum(misrouted_step),
@@ -443,7 +443,7 @@ class EdgeEngine:
     def _next_event(self, carry: EdgeState) -> jax.Array:
         """This device's next event time (NEVER = quiesced) — the
         while-loop condition shared by the local and sharded drivers."""
-        qmin = jnp.where(carry.q_valid, carry.q_rel, _I32MAX).min()
+        qmin = carry.q_rel.min()
         return jnp.minimum(
             carry.wake.min(),
             jnp.where(qmin < _I32MAX,

@@ -68,7 +68,6 @@ class ShardedEdgeEngine(ShardedDriver, EdgeEngine):
             q_rel=leaf(st.q_rel, True),
             q_step=leaf(st.q_step, True),
             q_pay=leaf(st.q_pay, True),
-            q_valid=leaf(st.q_valid, True),
             overflow=P(), unrouted=P(), misrouted=P(), bad_delay=P(),
             delivered=P(), steps=P(), time=P(),
         )

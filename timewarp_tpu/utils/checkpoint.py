@@ -10,6 +10,13 @@ scenario adds any, round-trip bit-for-bit through npz).
 Resume is ``engine.run(steps, state=load_state(path))`` — mid-run
 trace-parity across a save/load boundary is pinned by
 tests/test_checkpoint.py.
+
+Format compatibility: checkpoints are tied to the engine-state pytree
+of the code that wrote them; a state-layout change (e.g. round 3
+removing the derived mb_valid/q_valid leaves) makes older .npz files
+fail loudly at load ("checkpoint has N leaves / tree structure does
+not match") rather than resume wrong state. There is no silent
+migration — re-run from the scenario start or an on-format checkpoint.
 """
 
 from __future__ import annotations
