@@ -14,8 +14,9 @@ sharded on the all_to_all :class:`ShardedEngine`. The inbox reduces
 commutatively (min over hop counts), so no contract-#2 sort is
 compiled in.
 
-Payload layout: ``[hop, 0]`` — the relay depth at which the rumor
-travels; receivers adopt the minimum incoming hop.
+Payload layout: ``[hop]`` — the relay depth at which the rumor
+travels; receivers adopt the minimum incoming hop (width 1: one
+fewer mailbox scatter per superstep in the engines).
 """
 
 from __future__ import annotations
@@ -76,7 +77,7 @@ def gossip(n: int, *,
         out = Outbox(
             valid=due[None],
             dst=dst[None],
-            payload=jnp.stack([hop1 + 1, jnp.int32(0)])[None])
+            payload=(hop1 + 1).reshape(1, 1))
         if steady:
             left2 = left1                     # mongering never exhausts
             nxt2 = jnp.where(due, now + jnp.int64(gossip_interval), nxt1)
@@ -120,7 +121,7 @@ def gossip(n: int, *,
         step=step,
         init=init,
         init_batched=init_batched,
-        payload_width=2,
+        payload_width=1,
         max_out=1,
         mailbox_cap=mailbox_cap,
         commutative_inbox=True,
