@@ -13,7 +13,11 @@ figures at the bottom.
 
 import json
 import os
+import sys
 import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
 
 from timewarp_tpu.utils import jaxconfig  # noqa: F401
 
