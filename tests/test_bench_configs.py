@@ -29,5 +29,8 @@ def test_bench_main_prints_one_json_line(capsys, monkeypatch):
     out = capsys.readouterr().out.strip().splitlines()
     assert len(out) == 1
     row = json.loads(out[0])
-    assert set(row) == {"metric", "value", "unit", "vs_baseline"}
+    assert set(row) == {"metric", "value", "unit", "vs_baseline", "calib"}
     assert row["unit"] == "msg/s"
+    # the self-calibration fingerprint: frozen kernel, positive timing
+    assert row["calib"]["kernel"] == "sort_1m_int32_x64"
+    assert row["calib"]["seconds"] > 0

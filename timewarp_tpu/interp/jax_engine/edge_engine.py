@@ -68,9 +68,11 @@ class EdgeTopology(NamedTuple):
     """Host-side inversion of ``Scenario.static_dst`` (int32 [N, M],
     -1 = unused slot) into receiver-centric in-edge tables.
 
-    Edge index ``e`` within a node is its sender-major rank — the
-    arrival-order tie-break of determinism contract #3 falls out of
-    the table construction.
+    Edge order per node is *arbitrary* (the slot-major fast path orders
+    edges by outbox column, the inversion path by (src, slot) rank) —
+    contract #2/#3 ordering is enforced downstream by the explicit
+    ``(deliver_time, insert_step, src, slot)`` inbox sort keys, never
+    by edge index.
     """
     n_edges: int               # E = max in-degree
     in_valid: np.ndarray       # bool [E, N] — edge exists
@@ -84,6 +86,11 @@ class EdgeTopology(NamedTuple):
         sd = np.asarray(static_dst, np.int32)
         if sd.shape[0] != n:
             raise ValueError(f"static_dst rows {sd.shape[0]} != n_nodes {n}")
+        if n * sd.shape[1] >= 2**31:
+            # in_flat = slot*N + src must fit int32 (mirrors the
+            # JaxEngine smrank guard)
+            raise ValueError(
+                "n_nodes * max_out must fit int32 (in_flat gather index)")
         used = sd >= 0
         if np.any(sd[used] >= n):
             raise ValueError("static_dst contains out-of-range destination")

@@ -81,9 +81,11 @@ class ShardedEngine(ShardedDriver, JaxEngine):
     TCP sockets, `Transfer.hs:473,577`).
 
     Each device buckets its outgoing messages by destination shard
-    (stable, so sender-major order survives), the buckets swap in one
-    collective, and the received (src-shard-major, in-bucket) order
-    *is* global sender-major order — contract #3 for free. Bucket
+    (keyed on shard only, so in-bucket order is slot-major and
+    *irrelevant*), the buckets swap in one collective, and contract
+    #3's arrival order is restored downstream by the insertion sort on
+    the global sender-major rank (``smrank``) that rides along with
+    every message — exchange order never matters. Bucket
     capacity ``bucket_cap`` defaults to this device's total outbox
     width (``n_local * max_out``), which cannot overflow — bit-for-bit
     parity by construction; tune it down to shrink the exchange volume

@@ -52,6 +52,9 @@ def gossip(n: int, *,
     ``gossip_interval`` until the deadline (not fanout-bounded) — the
     classic epidemic steady state, and the dense general-engine
     regime (every infected node fires co-temporally each round)."""
+    if n < 2:
+        raise ValueError(f"gossip needs n >= 2 nodes, got {n} "
+                         "(peer draw divides by n - 1)")
 
     def step(state, inbox: Inbox, now, i, key):
         hop, lcg = state["hop"], state["lcg"]

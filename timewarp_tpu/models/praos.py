@@ -53,6 +53,10 @@ def praos(n: int, *,
     "stake nodes" of the baseline config; None = equal stake 1."""
     import numpy as _np
 
+    if n < 2:
+        raise ValueError(f"praos needs n >= 2 nodes, got {n} "
+                         "(peer draw divides by n - 1)")
+
     if stake is None:
         thr_arr = _np.full(
             n, min(int(leader_prob * 4294967296.0), 2**32 - 1),
