@@ -1,0 +1,216 @@
+"""Linked-lifetime (``fork_slave``) property suite under BOTH
+interpreters — the semantics the reference surveys but leaves
+``undefined`` in its emulator
+(`/root/reference/src/Control/TimeWarp/Timed/MonadTimed.hs:140-141`,
+`TimedT.hs:377`; real impl via the slave-thread library,
+`TimedIO.hs:78`). The contract (core/effects.py ForkSlave):
+
+1. a terminating master (return *or* death) kills its live slaves;
+2. slave kills cascade through slave subtrees;
+3. a slave's uncaught exception (other than ThreadKilled) is forwarded
+   to the master as an async exception;
+4. plain ``fork`` is unaffected (no linkage either way).
+
+Exact-timing assertions run under the emulator only; the real-mode leg
+asserts ordering/lifetime at millisecond scale (the reference reached
+the same split — MonadTimedSpec.hs:72-75).
+"""
+
+import pytest
+
+from timewarp_tpu import (ForkSlave, ThreadKilled, fork_slave, ms,
+                          run_emulation, run_real_time, sec, sleep_forever,
+                          wait)
+from timewarp_tpu.core.effects import Fork, GetTime, ThrowTo, Wait
+
+# Emulation uses big virtual delays (cost nothing); real mode scales
+# them down to milliseconds via the `unit` parameter. Exact time-bucket
+# assertions hold under the emulator only; the realtime leg tolerates
+# scheduler jitter of a few units (the reference reached the same
+# split, MonadTimedSpec.hs:72-75).
+RUNNERS = [
+    pytest.param(run_emulation, ms(1000), True, id="emulation"),
+    pytest.param(run_real_time, ms(10), False, id="realtime"),
+]
+
+
+def _sleepy(log, name, unit):
+    """A thread that sleeps forever and records its killed-time."""
+    def prog():
+        try:
+            yield from sleep_forever()
+        except ThreadKilled:
+            t = yield GetTime()
+            log.append((name, "killed", t // unit))
+            raise
+    return prog
+
+
+def _assert_event(log, name, kind, bucket, exact):
+    entries = [e for e in log if e[0] == name and e[1] == kind]
+    assert entries, f"no {kind} event for {name} in {log}"
+    if exact:
+        assert entries[0][2] == bucket, log
+    else:  # realtime: the event happened no earlier, with jitter slack
+        assert bucket <= entries[0][2] <= bucket + 5, log
+
+
+@pytest.mark.parametrize("run,unit,exact", RUNNERS)
+def test_master_return_kills_slave(run, unit, exact):
+    log = []
+
+    def master():
+        yield ForkSlave(_sleepy(log, "slave", unit))
+        yield Wait(2 * unit)
+        log.append(("master", "done"))
+
+    def main():
+        yield Fork(master)
+        yield Wait(8 * unit)
+
+    run(main)
+    assert ("master", "done") in log
+    _assert_event(log, "slave", "killed", 2, exact)
+
+
+@pytest.mark.parametrize("run,unit,exact", RUNNERS)
+def test_master_death_kills_slave(run, unit, exact):
+    log = []
+
+    def master():
+        yield ForkSlave(_sleepy(log, "slave", unit))
+        yield Wait(2 * unit)
+        raise RuntimeError("master dies")
+
+    def main():
+        yield Fork(master)
+        yield Wait(8 * unit)
+
+    run(main)
+    _assert_event(log, "slave", "killed", 2, exact)
+
+
+@pytest.mark.parametrize("run,unit,exact", RUNNERS)
+def test_slave_kill_cascades_through_subtree(run, unit, exact):
+    log = []
+
+    def mid():
+        yield ForkSlave(_sleepy(log, "grandslave", unit))
+        yield from _sleepy(log, "mid", unit)()
+
+    def master():
+        yield ForkSlave(mid)
+        yield Wait(3 * unit)
+        log.append(("master", "done"))
+
+    def main():
+        yield Fork(master)
+        yield Wait(9 * unit)
+
+    run(main)
+    _assert_event(log, "mid", "killed", 3, exact)
+    _assert_event(log, "grandslave", "killed", 3, exact)
+
+
+@pytest.mark.parametrize("run,unit,exact", RUNNERS)
+def test_slave_exception_forwarded_to_master(run, unit, exact):
+    log = []
+
+    def slave():
+        yield Wait(1 * unit)
+        raise ValueError("boom")
+
+    def master():
+        yield ForkSlave(slave)
+        try:
+            yield Wait(20 * unit)
+            log.append(("master", "not interrupted"))
+        except ValueError as e:
+            t = yield GetTime()
+            log.append(("master", str(e), t // unit))
+
+    def main():
+        yield Fork(master)
+        yield Wait(25 * unit)
+
+    run(main)
+    _assert_event(log, "master", "boom", 1, exact)
+
+
+@pytest.mark.parametrize("run,unit,exact", RUNNERS)
+def test_slave_threadkilled_not_forwarded(run, unit, exact):
+    """killThread-ing a slave must NOT ricochet into the master."""
+    log = []
+
+    def master():
+        stid = yield ForkSlave(_sleepy(log, "slave", unit))
+        yield Wait(1 * unit)
+        yield ThrowTo(stid, ThreadKilled())
+        try:
+            yield Wait(4 * unit)
+            log.append(("master", "undisturbed"))
+        except BaseException:  # noqa: BLE001
+            log.append(("master", "wrongly interrupted"))
+
+    def main():
+        yield Fork(master)
+        yield Wait(8 * unit)
+
+    run(main)
+    _assert_event(log, "slave", "killed", 1, exact)
+    assert ("master", "undisturbed") in log
+
+
+@pytest.mark.parametrize("run,unit,exact", RUNNERS)
+def test_plain_fork_is_not_linked(run, unit, exact):
+    """A plain fork survives its parent; its failures are not forwarded."""
+    log = []
+
+    def child():
+        try:
+            yield Wait(3 * unit)
+            log.append(("child", "survived"))
+        except BaseException:  # noqa: BLE001
+            log.append(("child", "wrongly killed"))
+
+    def parent():
+        yield Fork(child)
+        yield Wait(1 * unit)
+
+    def main():
+        yield Fork(parent)
+        yield Wait(8 * unit)
+
+    run(main)
+    assert ("child", "survived") in log
+
+
+@pytest.mark.parametrize("run,unit,exact", RUNNERS)
+def test_fork_slave_combinator_returns_tid(run, unit, exact):
+    got = []
+
+    def main():
+        tid = yield from fork_slave(lambda: wait(1 * unit))
+        got.append(tid)
+        yield Wait(2 * unit)
+
+    run(main)
+    assert len(got) == 1 and got[0] is not None
+
+
+def test_slave_killed_exactly_at_master_finish_emulation():
+    """Exact-virtual-time leg: slave's ThreadKilled is delivered at the
+    master's finish instant (emulator only — exact timing)."""
+    log = []
+
+    def master():
+        yield ForkSlave(_sleepy(log, "slave", 1))
+        yield Wait(12345)
+
+    def main():
+        yield Fork(master)
+        yield Wait(sec(1))
+
+    run_emulation(main)
+    # master forked at t=1 (handoff), finished at 1+12345
+    assert log == [("slave", "killed", 12346)]

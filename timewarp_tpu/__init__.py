@@ -19,10 +19,11 @@ node counts — the framework's core law, tested in tests/test_parity*).
 """
 
 from .core import effects, errors, time
-from .core.effects import (Fork, GetLogName, GetTime, MyTid, SetLogName,
-                           ThrowTo, Wait, fork, fork_, invoke, kill_thread,
-                           modify_log_name, my_thread_id, repeat_forever,
-                           schedule, sleep_forever, start_timer, timeout,
+from .core.effects import (Fork, ForkSlave, GetLogName, GetTime, MyTid,
+                           SetLogName, ThrowTo, Wait, fork, fork_,
+                           fork_slave, invoke, kill_thread, modify_log_name,
+                           my_thread_id, repeat_forever, schedule,
+                           sleep_forever, start_timer, timeout,
                            virtual_time, wait, work)
 from .core.errors import (AlreadyListening, MailboxOverflow, NetworkError,
                           PeerClosedConnection, ThreadKilled, TimedError,

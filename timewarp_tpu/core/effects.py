@@ -76,6 +76,25 @@ class Fork(Effect):
 
 
 @dataclass(frozen=True)
+class ForkSlave(Effect):
+    """Start a *linked* child thread (≙ ``forkSlave``,
+    MonadTimed.hs:140-141, bound to the slave-thread library in real
+    mode, TimedIO.hs:78; the reference's emulator leaves it
+    ``undefined`` — TimedT.hs:377 — this framework implements it under
+    BOTH interpreters). Handoff semantics are :class:`Fork`'s; the
+    linked lifetime adds:
+
+    - when the parent terminates (returns *or* dies), every live slave
+      receives ``ThreadKilled`` at its next suspension point — and a
+      dying slave kills its own slaves, so whole slave subtrees unwind;
+    - an uncaught exception in a slave (other than ``ThreadKilled``) is
+      *forwarded to the parent* as an async exception instead of being
+      logged-and-dropped like a plain fork's.
+    """
+    program: ProgramFn
+
+
+@dataclass(frozen=True)
 class GetTime(Effect):
     """Yields back the current virtual time in µs (≙ ``virtualTime``)."""
 
@@ -200,6 +219,12 @@ def await_io(awaitable: Any) -> Program:
 def fork_(program: ProgramFn) -> Program:
     """``fork`` discarding the tid (≙ ``fork_``, MonadTimed.hs:194-195)."""
     yield Fork(program)
+
+
+def fork_slave(program: ProgramFn) -> Program:
+    """Fork a linked (slave) thread; returns the child ThreadId
+    (≙ ``forkSlave``, MonadTimed.hs:141)."""
+    return (yield ForkSlave(program))
 
 
 def invoke(spec: Union[RelativeToNow, Microsecond], program: ProgramFn) -> Program:

@@ -41,8 +41,10 @@ import time as _time
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
 
-from ...core.effects import (AwaitIO, Fork, GetLogName, GetTime, MyTid, Park,
-                             ProgramFn, SetLogName, ThrowTo, Unpark, Wait)
+from ...core.effects import (AwaitIO, Fork, ForkSlave, GetLogName, GetTime,
+                             MyTid, Park, ProgramFn, SetLogName, ThrowTo,
+                             Unpark, Wait)
+from ...core.errors import ThreadKilled
 from ...core.time import Microsecond, resolve
 from ..common import NO_TOKEN as _NO_TOKEN
 from ..common import log_thread_death
@@ -73,6 +75,10 @@ class _Thread:
     park_token: Any = _NO_TOKEN
     parked: bool = False
     done: "asyncio.Event" = field(default_factory=asyncio.Event)
+    #: linked-lifetime bookkeeping (ForkSlave): slaves killed when this
+    #: thread terminates; master receives forwarded uncaught exceptions
+    slaves: Optional[List["AioThreadId"]] = None
+    master: Optional["AioThreadId"] = None
 
 
 class RealTime:
@@ -148,11 +154,32 @@ class RealTime:
         except BaseException as e:  # noqa: BLE001 — interpreter boundary
             if is_main:
                 raise
-            log_thread_death(_log, th.log_name, e)
+            # ForkSlave contract: forward a slave's uncaught exception
+            # (other than ThreadKilled) to its master (core/effects.py)
+            if (th.master is not None
+                    and not isinstance(e, ThreadKilled)
+                    and th.master in self._threads):
+                self._throw_to(th.master, e)
+            else:
+                log_thread_death(_log, th.log_name, e)
             return None
         finally:
             th.done.set()
             self._threads.pop(th.tid, None)
+            # ForkSlave contract: a terminating slave prunes itself from
+            # its master's list (keeps the list O(live slaves)); a
+            # terminating master kills its live slaves, cascading
+            # through slave subtrees via their own _drive finallys
+            if th.master is not None:
+                master = self._threads.get(th.master)
+                if master is not None and master.slaves:
+                    try:
+                        master.slaves.remove(th.tid)
+                    except ValueError:
+                        pass
+            if th.slaves:
+                for stid in th.slaves:
+                    self._throw_to(stid, ThreadKilled())
 
     async def _run_program(self, th: _Thread, program_fn: ProgramFn) -> Any:
         # Pre-start throw_to parity with the emulator (des.py _step): an
@@ -231,8 +258,13 @@ class RealTime:
                 value = self.virtual_time
             elif type(eff) is MyTid:
                 value = th.tid
-            elif type(eff) is Fork:
+            elif type(eff) is Fork or type(eff) is ForkSlave:
                 child = self._spawn(eff.program, th.log_name)
+                if type(eff) is ForkSlave:
+                    child.master = th.tid
+                    if th.slaves is None:
+                        th.slaves = []
+                    th.slaves.append(child.tid)
                 # forkIO-handoff parity with the emulator (des.py Fork:
                 # child enqueued at `now`, parent resumes at now+1, so
                 # the child reaches its first suspension first): yield

@@ -31,10 +31,10 @@ import logging
 from dataclasses import dataclass
 from typing import Any, Dict, List, Optional
 
-from ...core.effects import (AwaitIO, Effect, Fork, GetLogName, GetTime,
-                             MyTid, Park, Program, ProgramFn, SetLogName,
-                             ThrowTo, Unpark, Wait)
-from ...core.errors import DeadlockError, TimedError
+from ...core.effects import (AwaitIO, Effect, Fork, ForkSlave, GetLogName,
+                             GetTime, MyTid, Park, Program, ProgramFn,
+                             SetLogName, ThrowTo, Unpark, Wait)
+from ...core.errors import DeadlockError, ThreadKilled, TimedError
 from ..common import NO_TOKEN as _NO_TOKEN
 from ..common import log_thread_death
 from ...core.time import Microsecond, resolve
@@ -65,6 +65,11 @@ class _Thread:
     resume_entry: Optional[list] = None  # live queue entry, for wake-ups
     parked: bool = False
     park_token: Any = _NO_TOKEN           # pending unpark value
+    #: linked-lifetime bookkeeping (ForkSlave): tids of this thread's
+    #: slaves (killed when it finishes) and the master to forward
+    #: uncaught exceptions to (None for plain forks)
+    slaves: Optional[List["PureThreadId"]] = None
+    master: Optional["PureThreadId"] = None
 
 
 # Queue entry layout: [time, seq, tid, send_value, cancelled]
@@ -216,12 +221,18 @@ class PureEmulation:
                     value = self._time  # ≙ virtualTime (TimedT.hs:322)
                 elif type(eff) is MyTid:
                     value = th.tid
-                elif type(eff) is Fork:
+                elif type(eff) is Fork or type(eff) is ForkSlave:
                     # ≙ fork (TimedT.hs:326-342): child enqueued at `now`
                     # (inheriting the logger name), parent yields 1 µs and
-                    # then receives the child tid.
+                    # then receives the child tid. ForkSlave additionally
+                    # links the lifetimes (core/effects.py ForkSlave).
                     child = self._spawn(eff.program, th.log_name,
                                         is_main=False)
+                    if type(eff) is ForkSlave:
+                        child.master = th.tid
+                        if th.slaves is None:
+                            th.slaves = []
+                        th.slaves.append(child.tid)
                     self._push(child, self._time, None)
                     self._push(th, self._time + 1, child.tid)
                     return
@@ -289,13 +300,36 @@ class PureEmulation:
         # evict: memory stays O(live threads), not O(total forks);
         # _throw_to treats a missing tid exactly like a dead one
         self._threads.pop(th.tid, None)
+        # ForkSlave contract: a terminating master kills its live slaves
+        # (in creation order — deterministic event seq); their own
+        # _finish cascades through slave subtrees. A finishing slave
+        # prunes itself from its master's list first, keeping the list
+        # O(live slaves) — the O(live threads) memory invariant above.
+        if th.master is not None:
+            master = self._threads.get(th.master)
+            if master is not None and master.slaves:
+                try:
+                    master.slaves.remove(th.tid)
+                except ValueError:
+                    pass
+        if th.slaves:
+            for stid in th.slaves:
+                self._throw_to(stid, ThreadKilled())
         if th.is_main:
             if exc is not None:
                 main_error.append(exc)
             else:
                 main_result.append(result)
         elif exc is not None:
-            log_thread_death(_log, th.log_name, exc)
+            # ForkSlave contract: a slave's uncaught exception (other
+            # than ThreadKilled) is forwarded to its master instead of
+            # logged-and-dropped (≙ slave-thread's exception redirect).
+            if (th.master is not None
+                    and not isinstance(exc, ThreadKilled)
+                    and th.master in self._threads):
+                self._throw_to(th.master, exc)
+            else:
+                log_thread_death(_log, th.log_name, exc)
 
 
 def run_emulation(program_fn: ProgramFn, **kw: Any) -> Any:

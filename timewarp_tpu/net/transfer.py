@@ -37,7 +37,7 @@ import logging
 from dataclasses import dataclass
 from typing import Any, Callable, Dict, Optional
 
-from ..core.effects import Fork, Program, ThrowTo, Wait
+from ..core.effects import Fork, ForkSlave, Program, ThrowTo, Wait
 from ..core.errors import (AlreadyListening, PeerClosedConnection,
                            ThreadKilled)
 from ..core.time import Microsecond, sec
@@ -226,8 +226,12 @@ class SocketFrame:
                 if not ok:
                     return
 
-        stid = yield Fork(reporting(forever_send, "foreverSend"))
-        rtid = yield Fork(reporting(forever_rec, "foreverRec"))
+        # slave forks (≙ the slave-thread semantics forkSlave binds,
+        # TimedIO.hs:78): if the thread running process_socket is killed
+        # while blocked on the event channel below, the workers die with
+        # it instead of leaking until curator teardown
+        stid = yield ForkSlave(reporting(forever_send, "foreverSend"))
+        rtid = yield ForkSlave(reporting(forever_rec, "foreverRec"))
         _log.debug("start processing of socket to %s", self.peer_addr)
 
         def watcher() -> Program:
@@ -237,7 +241,7 @@ class SocketFrame:
             for tid in (stid, rtid):
                 yield ThrowTo(tid, ThreadKilled())
 
-        ctid = yield Fork(watcher)
+        ctid = yield ForkSlave(watcher)
         kind, err = yield from events.get()
         _log.debug("stop processing socket to %s", self.peer_addr)
         if kind == "error":
