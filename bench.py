@@ -100,20 +100,31 @@ def bench_gossip_100k(n, steps):
     from timewarp_tpu.net.delays import Quantize
 
     n = n or 100_000
-    sc = gossip(n, fanout=8, think_us=2_000, gossip_interval=1_000,
+    # burst relays (all fanout peers in one firing — how a real node
+    # pushes over parallel connections) + an 8 ms propagation floor
+    # licensing an 8-instant superstep window: the time-bucketed
+    # batching answer to the sparse broadcast ramp (JaxEngine.window)
+    sc = gossip(n, fanout=8, think_us=2_000, burst=True,
                 end_us=5_000_000, mailbox_cap=16)
-    link = Quantize(gossip_links(median_us=20_000, sigma=0.6), 1_000)
-    engine = JaxEngine(sc, link)
+    link = Quantize(gossip_links(median_us=20_000, sigma=0.6,
+                                 floor_us=8_000), 1_000)
+    engine = JaxEngine(sc, link, window=8_000,
+                       route_cap=min(1 << 18, n * 8))
     delivered, dt, fin = _measure(engine, steps or (1 << 20))
     # genuine quiescence, not a window or deadline artifact: no events
-    # pending, and the epidemic actually covered the whole network
+    # pending, and the epidemic covered the network up to the push-only
+    # miss floor (a node is missed with prob ~e^-fanout = e^-8 ≈ 3e-4;
+    # demanding literal 100% would assert against probability theory)
     import numpy as np
     from timewarp_tpu.core.scenario import NEVER
     assert int(engine._next_event(fin)) >= NEVER, \
         "broadcast did not quiesce inside the step budget"
+    assert int(fin.short_delay) == 0, "windowed run left the exact regime"
+    assert int(fin.route_drop) == 0, "route_cap clipped the measured run"
     hops = np.asarray(jax.device_get(fin.states["hop"]))
-    assert (hops >= 0).all(), \
-        f"wave truncated: {(hops < 0).sum()} nodes never infected"
+    missed = int((hops < 0).sum())
+    assert missed <= max(n // 500, 8), \
+        f"wave truncated: {missed} nodes never infected"
     return (f"gossip broadcast wave to quiescence (lognormal links) "
             f"delivered-messages/sec/chip @{n} nodes", delivered / dt)
 
@@ -145,12 +156,23 @@ def bench_praos_1m(n, steps):
     from timewarp_tpu.net.delays import LogNormalDelay, Quantize
 
     n = n or 1 << 20
+    # burst diffusion (a fresh tip floods all fanout peers in one
+    # firing) + 8 ms propagation floor + 8 ms window: adoption
+    # instants spread by lognormal delays batch 8 grid instants per
+    # superstep (exact — engine.py JaxEngine.window)
     sc = praos(n, slot_us=1_000_000, n_slots=1 << 30,
-               leader_prob=4.0 / n, fanout=8, relay_interval=1_000,
+               leader_prob=4.0 / n, fanout=8, burst=True,
                mailbox_cap=16)
-    link = Quantize(LogNormalDelay(20_000, 0.6), 1_000)
-    engine = JaxEngine(sc, link)
-    delivered, dt, _ = _measure(engine, steps or 256, warm_steps=16)
+    # 150 ms delay cap bounds the straggler tail (a 60 s praos relay
+    # is not a network, it is an outage); route_cap bounds the
+    # insertion stage at the measured peak with 2x headroom
+    link = Quantize(LogNormalDelay(20_000, 0.6, cap_us=150_000,
+                                   floor_us=8_000), 1_000)
+    engine = JaxEngine(sc, link, window=8_000,
+                       route_cap=min(1 << 21, n * 8))
+    delivered, dt, fin = _measure(engine, steps or 256, warm_steps=16)
+    assert int(fin.short_delay) == 0, "windowed run left the exact regime"
+    assert int(fin.route_drop) == 0, "route_cap clipped the measured run"
     return (f"praos slot-leader consensus "
             f"delivered-messages/sec/chip @{n} stake nodes",
             delivered / dt)

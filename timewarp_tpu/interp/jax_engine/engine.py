@@ -76,6 +76,13 @@ class EngineState(NamedTuple):
     overflow: jax.Array    # int32[] — total overflowed messages
     bad_dst: jax.Array     # int32[] — total messages to invalid destinations
     bad_delay: jax.Array   # int32[] — delays >= 2^31 µs, clamped
+    #: int32[] — delays < the superstep window (would violate the
+    #: windowed-execution causality precondition; see JaxEngine.window)
+    short_delay: jax.Array
+    #: int32[] — routed messages beyond ``route_cap`` dropped at the
+    #: insertion stage (an engine capacity limit, not a semantic one —
+    #: a parity run must keep this 0; see JaxEngine.route_cap)
+    route_drop: jax.Array
     delivered: jax.Array   # int64[] — total delivered messages
     steps: jax.Array       # int64[] — supersteps executed
     time: jax.Array        # int64[] — current virtual time == mailbox epoch
@@ -89,15 +96,61 @@ class JaxEngine:
     (pure ``lax.while_loop``, digests not compiled in) for
     benchmarking. Static-topology scenarios should prefer
     :class:`~timewarp_tpu.interp.jax_engine.edge_engine.EdgeEngine`.
+
+    Multi-instant windowed supersteps (``window`` µs, default 1 =
+    classic fire-all-at-min): one superstep fires *every* node whose
+    next event lies in ``[t, t + window)``, each at its **own** instant
+    (per-node ``now``; per-instant entropy; wake clamp past the node's
+    own instant). This is *exact* — identical event semantics to
+    window=1, superstep granularity aside — when every link delay is
+    ≥ ``window``: an in-window send then arrives at or past the window
+    end, so in-window firings are causally independent. The constructor
+    validates ``window <= link.min_delay_us`` (net/delays.py), and any
+    dynamically sampled shorter delay is counted in
+    ``EngineState.short_delay`` (a nonzero count marks the run as
+    outside the exact regime — never silent). Sparse workloads whose
+    events spread over many close-together instants (Praos slots,
+    gossip waves — SURVEY.md §5.7 time-bucketed batching) gain up to
+    window/grid × messages per superstep at the same superstep cost.
+
+    Two throughput knobs for wide-outbox scenarios (burst diffusion —
+    ``max_out`` ≥ 8 makes the S = N·max_out routing arrays dominate):
+
+    - ``commutative_inbox`` scenarios skip the contract-#2 inbox sort
+      entirely (the step reduces over the inbox commutatively, so slot
+      order is unobservable; digests are order-independent) — the same
+      waiver the edge engine already exercises;
+    - ``route_cap`` statically bounds the insertion stage: after the
+      routing sort (valid messages first), only the first ``route_cap``
+      entries are ranked/scattered. Exact whenever the per-superstep
+      active message count stays under the cap; beyond it messages are
+      dropped and counted in ``EngineState.route_drop`` (an engine
+      capacity limit the oracle does not model — a parity run must
+      keep the counter 0, like ``short_delay``).
     """
 
     def __init__(self, scenario: Scenario, link: LinkModel, *,
-                 seed: int = 0) -> None:
+                 seed: int = 0, window: int = 1,
+                 route_cap: Optional[int] = None) -> None:
         if scenario.n_nodes * scenario.max_out >= 2**31:
             raise ValueError(
                 "n_nodes * max_out must fit int32 (sender-major rank)")
+        if window < 1:
+            raise ValueError(f"window must be >= 1 µs, got {window}")
+        if window > 1 and window > link.min_delay_us:
+            raise ValueError(
+                f"window={window} µs exceeds the link model's declared "
+                f"min_delay_us={link.min_delay_us}; windowed supersteps "
+                "would reorder causally dependent events (engine.py "
+                "windowed-execution precondition)")
+        if window >= _I32MAX:
+            raise ValueError("window must fit int32")
+        if route_cap is not None and route_cap < 1:
+            raise ValueError(f"route_cap must be >= 1, got {route_cap}")
         self.scenario = scenario
         self.link = link
+        self.window = int(window)
+        self.route_cap = None if route_cap is None else int(route_cap)
         self.s0, self.s1 = seed_words(seed)
         self.comm = LocalComm(scenario.n_nodes)
 
@@ -124,6 +177,8 @@ class JaxEngine:
             overflow=jnp.int32(0),
             bad_dst=jnp.int32(0),
             bad_delay=jnp.int32(0),
+            short_delay=jnp.int32(0),
+            route_drop=jnp.int32(0),
             delivered=jnp.int64(0),
             steps=jnp.int64(0),
             time=jnp.int64(0),
@@ -131,19 +186,20 @@ class JaxEngine:
 
     # -- one superstep ---------------------------------------------------
 
-    def _exchange(self, ok, drel, src_f, dst_f, smrank, pay_cols):
+    def _exchange(self, ok, drel, src_f, dst_f, smrank, woff, pay_cols):
         """Hand routed messages to the device that owns their
         destination, returning ``(ok, drel, src, local_row, smrank,
-        pay_cols, bucket_overflow)`` for the messages *this* device's
-        nodes will receive. Single chip: identity — the global
+        woff, pay_cols, bucket_overflow)`` for the messages *this*
+        device's nodes will receive. Single chip: identity — the global
         destination id is the local mailbox row. The sharded engine
         (sharded.py) overrides this with destination-shard bucketing +
         one ``lax.all_to_all``; bucket overflow is counted, never
         silent. ``dst_f`` is the global destination, already validated;
         ``smrank`` is the message's global sender-major rank
-        (``src * max_out + slot``) — insertion sorts on it, so exchange
-        order never matters."""
-        return ok, drel, src_f, dst_f, smrank, pay_cols, jnp.int32(0)
+        (``src * max_out + slot``) and ``woff`` its in-window send
+        offset — insertion sorts on (woff, smrank), so exchange order
+        never matters."""
+        return ok, drel, src_f, dst_f, smrank, woff, pay_cols, jnp.int32(0)
 
     def _superstep(self, st: EngineState, with_trace: bool
                    ) -> Tuple[EngineState, Optional[_StepOut]]:
@@ -156,6 +212,7 @@ class JaxEngine:
 
         # validity is the rel sentinel (I32MAX = empty slot)
         mb_live = st.mb_rel < _I32MAX                           # [K, N]
+        W = self.window
 
         # 1. global next event time (the batched "pop min", TimedT.hs:241-245)
         nnr = st.mb_rel.min(axis=0)
@@ -165,89 +222,147 @@ class JaxEngine:
                       base + nnr.astype(jnp.int64)))
         t = comm.all_min(node_next.min())
         live = t < NEVER
-        fire = (node_next == t) & live
+        # windowed firing: every node with an event in [t, t+W) fires,
+        # each at its OWN instant (W=1 degenerates to == t, since t is
+        # the global min). In-window firings are causally independent
+        # because link delays are >= W (validated in __init__; counted
+        # in short_delay below when violated).
+        fire = (node_next < NEVER) & (node_next - t < W) & live
+        #: per-node firing instant; t for non-fired (their results are
+        #: masked, but the step function must see a sane `now`)
+        now_vec = jnp.where(fire, node_next, t)                 # int64[N]
         shift32 = jnp.minimum(t - base,
                               jnp.int64(_I32MAX - 1)).astype(jnp.int32)
+        #: per-node deliver horizon relative to the epoch
+        nrel = jnp.minimum(now_vec - base,
+                           jnp.int64(_I32MAX - 1)).astype(jnp.int32)
 
-        # 2. deliverable messages, per firing node
-        deliver = mb_live & (st.mb_rel <= shift32) & fire[None, :]
+        # 2. deliverable messages: due at or before the node's own
+        #    firing instant (== `<= shift32` when W == 1)
+        deliver = mb_live & (st.mb_rel <= nrel[None, :]) & fire[None, :]
 
         # 3. inbox: delivered slots first, ordered by (time, arrival slot)
-        #    (determinism contract #2) — one variadic sort along K
+        #    (determinism contract #2) — one variadic sort along K.
+        #    Commutative-inbox scenarios waive the ordering (slot order
+        #    is unobservable to a commutative reduction; digests are
+        #    order-independent), so the [K, N] sort is skipped and the
+        #    inbox is the raw mailbox under the deliver mask — the same
+        #    waiver the edge engine exercises (edge_engine.py).
         slots = jnp.broadcast_to(
             jnp.arange(K, dtype=jnp.int32)[:, None], (K, n))
-        rel_key = jnp.where(deliver, st.mb_rel, _I32MAX)
-        ops = jax.lax.sort(
-            (~deliver, rel_key, slots, st.mb_src) + tuple(
-                st.mb_payload[:, p, :] for p in range(P)),
-            dimension=0, num_keys=3)
-        ib_valid, ib_rel, ib_src = ~ops[0], ops[1], ops[3]
-        ib_pay = jnp.stack(ops[4:4 + P], axis=1)                # [K, P, N]
-        # pad invalid slots exactly like the oracle (src=0, time=NEVER,
-        # payload=0) so an unmasked read in a user step function cannot
-        # diverge between interpreters
-        inbox = Inbox(
-            valid=ib_valid,
-            src=jnp.where(ib_valid, ib_src, 0),
-            time=jnp.where(ib_valid, base + ib_rel.astype(jnp.int64),
-                           jnp.int64(NEVER)),
-            payload=jnp.where(ib_valid[:, None, :], ib_pay, 0),
-        )
+        if sc.commutative_inbox:
+            inbox = Inbox(
+                valid=deliver,
+                src=jnp.where(deliver, st.mb_src, 0),
+                time=jnp.where(deliver,
+                               base + st.mb_rel.astype(jnp.int64),
+                               jnp.int64(NEVER)),
+                payload=jnp.where(deliver[:, None, :], st.mb_payload, 0),
+            )
+        else:
+            rel_key = jnp.where(deliver, st.mb_rel, _I32MAX)
+            ops = jax.lax.sort(
+                (~deliver, rel_key, slots, st.mb_src) + tuple(
+                    st.mb_payload[:, p, :] for p in range(P)),
+                dimension=0, num_keys=3)
+            ib_valid, ib_rel, ib_src = ~ops[0], ops[1], ops[3]
+            ib_pay = jnp.stack(ops[4:4 + P], axis=1)            # [K, P, N]
+            # pad invalid slots exactly like the oracle (src=0,
+            # time=NEVER, payload=0) so an unmasked read in a user step
+            # function cannot diverge between interpreters
+            inbox = Inbox(
+                valid=ib_valid,
+                src=jnp.where(ib_valid, ib_src, 0),
+                time=jnp.where(ib_valid, base + ib_rel.astype(jnp.int64),
+                               jnp.int64(NEVER)),
+                payload=jnp.where(ib_valid[:, None, :], ib_pay, 0),
+            )
 
-        # 4. fire every node simultaneously; mask non-fired results.
-        # Entropy is derived elementwise (core/rng.py) — no key arrays.
+        # 4. fire every node simultaneously, each at its own instant;
+        # mask non-fired results. Entropy is derived elementwise
+        # (core/rng.py), keyed by the node's own firing instant — the
+        # same bits a window=1 run derives for that (node, time) firing.
         # Batch axis is the *minor* dim for inbox and outbox leaves.
-        bits = fire_bits(self.s0, self.s1, node_ids, t) \
+        bits = fire_bits(self.s0, self.s1, node_ids, now_vec) \
             if sc.needs_key else None
         new_states, out, new_wake = jax.vmap(
             sc.step,
             in_axes=(0, Inbox(valid=-1, src=-1, time=-1, payload=-1),
-                     None, 0, None if bits is None else 0),
+                     0, 0, None if bits is None else 0),
             out_axes=(0, Outbox(valid=-1, dst=-1, payload=-1), 0))(
-                st.states, inbox, t, node_ids, bits)
+                st.states, inbox, now_vec, node_ids, bits)
         states = jax.tree.map(
             lambda a, b: jnp.where(
                 fire.reshape((n,) + (1,) * (b.ndim - 1)), b, a),
             st.states, new_states)
         new_wake = jnp.where(new_wake >= NEVER, NEVER,
-                             jnp.maximum(new_wake, t + 1))  # contract #5
+                             jnp.maximum(new_wake, now_vec + 1))  # contract #5
         wake = jnp.where(fire, new_wake, st.wake)
         out_valid = out.valid & fire[None, :]                   # [M, N]
 
-        # 5. compact mailboxes: drop delivered, keep arrival order,
-        #    rebase surviving deliver-times to the new epoch t
+        # 5. drop delivered messages and rebase surviving deliver-times
+        #    to the new epoch t. Two regimes:
+        #    - commutative inbox: slot order is unobservable, so freed
+        #      slots become *holes* (elementwise — no [K, N] compaction
+        #      sort) and insertion targets the r-th free slot via a
+        #      single-operand sort of free-slot rows. Overflow semantics
+        #      are bit-identical: rank >= #free ⇔ counts + rank >= K.
+        #    - ordered inbox: the variadic compaction sort keeps arrival
+        #      order materialized in slot order (contract #2's tiebreak).
         keep = mb_live & ~deliver
-        ops2 = jax.lax.sort(
-            (~keep, slots, st.mb_rel, st.mb_src) + tuple(
-                st.mb_payload[:, p, :] for p in range(P)),
-            dimension=0, num_keys=2)
-        kept = ~ops2[0]
-        mb_rel = jnp.where(kept, ops2[2] - shift32, _I32MAX)
-        mb_src = ops2[3]
-        mb_payload = jnp.stack(ops2[4:4 + P], axis=1)
-        counts = kept.sum(axis=0, dtype=jnp.int32)              # [N]
+        if sc.commutative_inbox:
+            mb_rel = jnp.where(keep, st.mb_rel - shift32, _I32MAX)
+            mb_src = st.mb_src          # stale in holes; validity is the
+            mb_payload = st.mb_payload  # rel sentinel, never these
+            #: free_rows[r, i] = row of node i's r-th free slot (K = none)
+            free_rows = jax.lax.sort(
+                jnp.where(keep, jnp.int32(K), slots), dimension=0)
+            counts = None
+        else:
+            ops2 = jax.lax.sort(
+                (~keep, slots, st.mb_rel, st.mb_src) + tuple(
+                    st.mb_payload[:, p, :] for p in range(P)),
+                dimension=0, num_keys=2)
+            kept = ~ops2[0]
+            mb_rel = jnp.where(kept, ops2[2] - shift32, _I32MAX)
+            mb_src = ops2[3]
+            mb_payload = jnp.stack(ops2[4:4 + P], axis=1)
+            free_rows = None
+            counts = kept.sum(axis=0, dtype=jnp.int32)          # [N]
 
-        # 6. route outboxes; arrival order is fixed later by the global
-        #    sender-major rank key, so the flatten order is free
-        #    (slot-major — no transpose of the [M, N] outbox)
+        # 6. route outboxes; arrival order is fixed later by the
+        #    (window offset, sender-major rank) keys, so the flatten
+        #    order is free (slot-major — no transpose of the [M, N]
+        #    outbox). Each message is stamped with its sender's firing
+        #    instant (== t for W == 1), which keys the link entropy.
         S = n * M
         src_f = jnp.tile(node_ids, M)
         slot_f = jnp.repeat(jnp.arange(M, dtype=jnp.int32), n)
+        tmsg = jnp.tile(now_vec, M)                             # int64[S]
         dst_f = out.dst.reshape(S).astype(jnp.int32)
         pay_cols = tuple(out.payload[:, p, :].reshape(S) for p in range(P))
         v_f = out_valid.reshape(S)
-        mbits = msg_bits(self.s0, self.s1, src_f, dst_f, t, slot_f) \
+        mbits = msg_bits(self.s0, self.s1, src_f, dst_f, tmsg, slot_f) \
             if self.link.needs_key else None
-        delay, drop = self.link.sample(src_f, dst_f, t, mbits)
+        delay, drop = self.link.sample(src_f, dst_f, tmsg, mbits)
         dst_ok = (dst_f >= 0) & (dst_f < n_glob)
         ok = v_f & ~drop & dst_ok
         # contract #6 corollary: a scenario emitting an out-of-range
         # destination is a bug — surfaced, never silently dropped
         bad_dst_step = comm.all_sum(
             jnp.sum(v_f & ~dst_ok, dtype=jnp.int32))
-        drel64 = jnp.maximum(delay, jnp.int64(1))  # contract #4
+        flight = jnp.maximum(delay, jnp.int64(1))  # contract #4
+        # in-window send offset: deliver-times stay epoch(t)-relative
+        woff = (tmsg - t).astype(jnp.int32)                     # [0, W)
+        drel64 = woff.astype(jnp.int64) + flight
         bad_delay_step = comm.all_sum(jnp.sum(
             ok & (drel64 > jnp.int64(_I32MAX - 1)), dtype=jnp.int32))
+        # windowed-causality violation: a delay shorter than the window
+        # means this message should have been visible to a node that
+        # already fired in this very window — counted, never silent
+        short_step = comm.all_sum(jnp.sum(
+            ok & (flight < W), dtype=jnp.int32)) \
+            if W > 1 else jnp.int32(0)
         drel = jnp.minimum(drel64, jnp.int64(_I32MAX - 1)).astype(jnp.int32)
         # global sender-major rank — contract #3's arrival order as a
         # sortable value (init guards n_glob * M < 2^31)
@@ -256,26 +371,60 @@ class JaxEngine:
         # 6.5. hand each message to the device that owns its destination
         # (identity single-chip; bucket + all_to_all sharded) — rows come
         # back device-local
-        ok_r, drel_r, src_r, row_r, smrank_r, pay_r, bucket_ovf = \
-            self._exchange(ok, drel, src_f, dst_f, smrank, pay_cols)
+        ok_r, drel_r, src_r, row_r, smrank_r, woff_r, pay_r, bucket_ovf = \
+            self._exchange(ok, drel, src_f, dst_f, smrank, woff, pay_cols)
 
-        # 7. insert: ONE variadic sort by (destination, sender-major
-        #    rank) — values ride along, replacing the argsort + gather
-        #    chain (gathers cost ~1 ms/131k on TPU; sort is ~free)
+        # 7. insert: ONE variadic sort by (destination, send instant,
+        #    sender-major rank) — chronological routing order, contract
+        #    #3 (for W == 1 all offsets are 0 and the key is elided);
+        #    values ride along, replacing the argsort + gather chain
+        #    (gathers cost ~1 ms/131k on TPU; sort is ~free)
+        # sort operands are pruned to the minimum: validity is derived
+        # from the destination sentinel (sd < n ⇔ ok) and the sender
+        # from the rank key (src = smrank // M) — every dropped operand
+        # is S elements of sort traffic saved
         sort_dst = jnp.where(ok_r, row_r, n)  # invalid -> sentinel row n
-        ops3 = jax.lax.sort(
-            (sort_dst, smrank_r, ok_r, drel_r, src_r) + pay_r,
-            dimension=0, num_keys=2)
-        sd, ok_s, drel_s, src_s = ops3[0], ops3[2], ops3[3], ops3[4]
-        pos = counts[jnp.clip(sd, 0, n - 1)] + group_rank(sd)
-        fits = ok_s & (pos < K)
+        if W > 1:
+            ops3 = jax.lax.sort(
+                (sort_dst, woff_r, smrank_r, drel_r) + pay_r,
+                dimension=0, num_keys=3)
+            ops3 = ops3[:1] + ops3[2:]  # drop woff; layout as below
+        else:
+            ops3 = jax.lax.sort(
+                (sort_dst, smrank_r, drel_r) + pay_r,
+                dimension=0, num_keys=2)
+        # route_cap: valid messages sort to the front (sentinel row n is
+        # the largest key), so ranking + scattering only a static prefix
+        # is exact while the active count fits; the excess is counted
+        route_drop_step = jnp.int32(0)
+        A = self.route_cap
+        if A is not None and A < ops3[0].shape[0]:
+            total_ok = jnp.sum(ok_r, dtype=jnp.int32)
+            ops3 = tuple(o[:A] for o in ops3)
+            route_drop_step = total_ok - jnp.sum(
+                ops3[0] < n, dtype=jnp.int32)
+        route_drop_step = comm.all_sum(route_drop_step)
+        sd, drel_s = ops3[0], ops3[2]
+        ok_s = sd < n
+        src_s = ops3[1] // jnp.int32(M)   # smrank = src * M + slot
+        rank = group_rank(sd)
+        if sc.commutative_inbox:
+            # r-th incoming message takes the destination's r-th hole
+            prow = free_rows[jnp.clip(rank, 0, K - 1),
+                             jnp.clip(sd, 0, n - 1)]
+            fits = ok_s & (rank < K) & (prow < K)
+            col = jnp.clip(prow, 0, K - 1)
+            pos = jnp.where(fits, jnp.int32(0), jnp.int32(K))  # overflow key
+        else:
+            pos = counts[jnp.clip(sd, 0, n - 1)] + rank
+            fits = ok_s & (pos < K)
+            col = jnp.clip(pos, 0, K - 1)
         row = jnp.where(fits, sd, n)  # out-of-range row -> dropped scatter
-        col = jnp.clip(pos, 0, K - 1)
         mb_rel = mb_rel.at[col, row].set(drel_s, mode="drop")
         mb_src = mb_src.at[col, row].set(src_s, mode="drop")
         for p in range(P):
             mb_payload = mb_payload.at[col, p, row].set(
-                ops3[5 + p], mode="drop")
+                ops3[3 + p], mode="drop")
         overflow_step = comm.all_sum(
             jnp.sum(ok_s & (pos >= K), dtype=jnp.int32)) + bucket_ovf
 
@@ -286,6 +435,8 @@ class JaxEngine:
             overflow=st.overflow + overflow_step,
             bad_dst=st.bad_dst + bad_dst_step,
             bad_delay=st.bad_delay + bad_delay_step,
+            short_delay=st.short_delay + short_step,
+            route_drop=st.route_drop + route_drop_step,
             delivered=st.delivered + recv_count.astype(jnp.int64),
             steps=st.steps + 1,
             time=t,
@@ -307,7 +458,7 @@ class JaxEngine:
             st.mb_src, _tlo(d_abs), _thi(d_abs),
             st.mb_payload[:, 0, :])
         recv_hash = comm.all_sum(_u32sum(jnp.where(deliver, recv_mix, 0)))
-        dt_abs = t + drel64
+        dt_abs = t + drel64  # == send instant + flight time
         sent_mix = mix32_jnp(SENT, src_f, dst_f, _tlo(dt_abs), _thi(dt_abs),
                              pay_cols[0])
         sent_hash = comm.all_sum(_u32sum(jnp.where(ok, sent_mix, 0)))

@@ -95,8 +95,11 @@ class ShardedEngine(ShardedDriver, JaxEngine):
 
     def __init__(self, scenario: Scenario, link: LinkModel,
                  mesh: Mesh, *, axis: AxisName = "nodes", seed: int = 0,
-                 bucket_cap: Optional[int] = None) -> None:
-        super().__init__(scenario, link, seed=seed)
+                 bucket_cap: Optional[int] = None,
+                 window: int = 1,
+                 route_cap: Optional[int] = None) -> None:
+        super().__init__(scenario, link, seed=seed, window=window,
+                         route_cap=route_cap)
         self.mesh = mesh
         self.axis = axis
         D = axis_size(mesh, axis)
@@ -107,16 +110,16 @@ class ShardedEngine(ShardedDriver, JaxEngine):
 
     # -- the all_to_all exchange -----------------------------------------
 
-    def _exchange(self, ok, drel, src_f, dst_f, smrank, pay_cols):
+    def _exchange(self, ok, drel, src_f, dst_f, smrank, woff, pay_cols):
         comm = self.comm
         D, nl, B = comm.n_shards, comm.n_local, self.bucket_cap
         # destination shard of each message; invalid -> sentinel D.
         # One variadic sort groups messages by shard with all values
         # riding along (no argsort + gather chain); in-bucket order is
-        # irrelevant — insertion downstream sorts on smrank.
+        # irrelevant — insertion downstream sorts on (woff, smrank).
         dshard = jnp.where(ok, dst_f // jnp.int32(nl), jnp.int32(D))
         ops = jax.lax.sort(
-            (dshard, drel, src_f, dst_f, smrank) + pay_cols,
+            (dshard, drel, src_f, dst_f, smrank, woff) + pay_cols,
             dimension=0, num_keys=1)
         sk = ops[0]
         rank = group_rank(sk)
@@ -141,13 +144,14 @@ class ShardedEngine(ShardedDriver, JaxEngine):
                 x, self.axis, split_axis=0, concat_axis=0).reshape(D * B)
 
         r_ok = a2a(b_ok).astype(bool)
-        r_drel, r_src, r_dst, r_smrank = (a2a(b) for b in bufs[1:5])
-        r_pay = tuple(a2a(b) for b in bufs[5:])
+        r_drel, r_src, r_dst, r_smrank, r_woff = (
+            a2a(b) for b in bufs[1:6])
+        r_pay = tuple(a2a(b) for b in bufs[6:])
         # received rows are local: subtract this shard's node offset
         off = jax.lax.axis_index(self.axis).astype(jnp.int32) \
             * jnp.int32(nl)
-        return (r_ok, r_drel, r_src, r_dst - off, r_smrank, r_pay,
-                bucket_ovf)
+        return (r_ok, r_drel, r_src, r_dst - off, r_smrank, r_woff,
+                r_pay, bucket_ovf)
 
     # -- sharding specs --------------------------------------------------
 
@@ -159,6 +163,7 @@ class ShardedEngine(ShardedDriver, JaxEngine):
             mb_rel=leaf(st.mb_rel, True),
             mb_src=leaf(st.mb_src, True),
             mb_payload=leaf(st.mb_payload, True),
-            overflow=P(), bad_dst=P(), bad_delay=P(),
+            overflow=P(), bad_dst=P(), bad_delay=P(), short_delay=P(),
+            route_drop=P(),
             delivered=P(), steps=P(), time=P(),
         )

@@ -44,12 +44,28 @@ _MASK32 = (1 << 32) - 1
 
 
 class SuperstepOracle:
-    """Sequential host executor; oracle for trace parity."""
+    """Sequential host executor; oracle for trace parity.
+
+    ``window`` mirrors the engine's multi-instant windowed supersteps
+    (interp/jax_engine/engine.py ``JaxEngine.window``): one superstep
+    fires every node with an event in ``[t, t+window)``, each at its
+    own instant, routing in chronological ``(instant, sender, slot)``
+    order. Exact when link delays are ≥ window (validated here too;
+    dynamic violations counted in ``short_delay_total``).
+    """
 
     def __init__(self, scenario: Scenario, link: LinkModel, *,
-                 seed: int = 0, record_events: bool = False) -> None:
+                 seed: int = 0, record_events: bool = False,
+                 window: int = 1) -> None:
+        if window < 1:
+            raise ValueError(f"window must be >= 1 µs, got {window}")
+        if window > 1 and window > link.min_delay_us:
+            raise ValueError(
+                f"window={window} µs exceeds the link model's declared "
+                f"min_delay_us={link.min_delay_us}")
         self.scenario = scenario
         self.link = link
+        self.window = int(window)
         self.s0, self.s1 = seed_words(seed)
         #: optional per-event debug log (SURVEY.md §5.1): tuples
         #: ("fire", t, node) / ("recv", t, node, src, deliver_t, pay0)
@@ -68,6 +84,7 @@ class SuperstepOracle:
         self.mailbox: List[List[tuple]] = [[] for _ in range(n)]
         self.overflow_total = 0
         self.bad_dst_total = 0
+        self.short_delay_total = 0
         self.time: Microsecond = 0
 
         ids = jnp.arange(n, dtype=jnp.int32)
@@ -76,27 +93,29 @@ class SuperstepOracle:
         slot_f = jnp.tile(jnp.arange(M, dtype=jnp.int32), n)
 
         # one vmapped step per superstep — same fn the engine vmaps;
-        # entropy derived elementwise (core/rng.py), no key arrays
-        def _vstep(states, inbox, t):
+        # entropy derived elementwise (core/rng.py), no key arrays.
+        # `now` is per-node (each fires at its own in-window instant;
+        # all equal to t when window == 1).
+        def _vstep(states, inbox, now):
             if scenario.needs_key:
-                bits = fire_bits(self.s0, self.s1, ids, t)
+                bits = fire_bits(self.s0, self.s1, ids, now)
             else:
                 bits = None
             return jax.vmap(
                 scenario.step,
-                in_axes=(0, 0, None, 0, None if bits is None else 0))(
-                    states, inbox, t, ids, bits)
+                in_axes=(0, 0, 0, 0, None if bits is None else 0))(
+                    states, inbox, now, ids, bits)
 
         self._vstep = jax.jit(_vstep)
 
-        # one batched link sample per superstep, keyed per (src,dst,t,slot);
-        # link models broadcast — no vmap needed
-        def _vsample(dst, t):
+        # one batched link sample per superstep, keyed per
+        # (src,dst,send-instant,slot); link models broadcast — no vmap
+        def _vsample(dst, tmsg):
             if link.needs_key:
-                bits = msg_bits(self.s0, self.s1, src_f, dst, t, slot_f)
+                bits = msg_bits(self.s0, self.s1, src_f, dst, tmsg, slot_f)
             else:
                 bits = None
-            return link.sample(src_f, dst, t, bits)
+            return link.sample(src_f, dst, tmsg, bits)
 
         self._vsample = jax.jit(_vsample)
 
@@ -112,6 +131,7 @@ class SuperstepOracle:
             until: Optional[Microsecond] = None) -> SuperstepTrace:
         sc = self.scenario
         n, M, K, P = sc.n_nodes, sc.max_out, sc.mailbox_cap, sc.payload_width
+        W = self.window
         rows = []
         for _ in range(max_steps):
             nexts = [self._node_next(i) for i in range(n)]
@@ -119,12 +139,16 @@ class SuperstepOracle:
             if t >= NEVER or (until is not None and t > until):
                 break
             self.time = t
-            fired = [i for i in range(n) if nexts[i] == t]
+            # windowed firing: every node with an event in [t, t+W),
+            # each at its own instant nexts[i] (== t for W == 1)
+            fired = [i for i in range(n)
+                     if nexts[i] < NEVER and nexts[i] - t < W]
             fired_hash = combine_py(mix32_py(FIRED, i) for i in fired)
             if self.events is not None:
-                self.events.extend(("fire", t, i) for i in fired)
+                self.events.extend(("fire", nexts[i], i) for i in fired)
 
-            # build inboxes (host decision: contract #2 ordering)
+            # build inboxes (host decision: contract #2 ordering);
+            # deliverable = due at the node's own firing instant
             ib_valid = np.zeros((n, K), bool)
             ib_src = np.zeros((n, K), np.int32)
             ib_time = np.full((n, K), NEVER, np.int64)
@@ -132,11 +156,12 @@ class SuperstepOracle:
             recv_hashes: List[int] = []
             recv_count = 0
             for i in fired:
+                ti = nexts[i]
                 pend = self.mailbox[i]
                 picked = sorted(
-                    ((m, idx) for idx, m in enumerate(pend) if m[0] <= t),
+                    ((m, idx) for idx, m in enumerate(pend) if m[0] <= ti),
                     key=lambda mi: (mi[0][0], mi[1]))
-                self.mailbox[i] = [m for m in pend if m[0] > t]
+                self.mailbox[i] = [m for m in pend if m[0] > ti]
                 for j, (m, _) in enumerate(picked):
                     ib_valid[i, j] = True
                     ib_time[i, j] = m[0]
@@ -147,14 +172,19 @@ class SuperstepOracle:
                         int(m[2][0]) if P else 0))
                     if self.events is not None:
                         self.events.append(
-                            ("recv", t, i, int(m[1]), int(m[0]),
+                            ("recv", ti, i, int(m[1]), int(m[0]),
                              int(m[2][0]) if P else 0))
                 recv_count += len(picked)
+
+            # per-node firing instants (t for unfired — masked anyway)
+            now_arr = np.full(n, t, np.int64)
+            for i in fired:
+                now_arr[i] = nexts[i]
 
             inbox = Inbox(valid=ib_valid, src=ib_src, time=ib_time,
                           payload=ib_pay)
             new_states, out, new_wake = self._vstep(
-                self.states, inbox, jnp.int64(t))
+                self.states, inbox, jnp.asarray(now_arr))
             new_states = jax.tree.map(np.asarray, new_states)
             out_valid = np.asarray(out.valid)
             out_dst = np.asarray(out.dst, dtype=np.int32)
@@ -169,18 +199,22 @@ class SuperstepOracle:
             self.states = jax.tree.map(_apply, self.states, new_states)
             for i in fired:
                 w = int(new_wake[i])
-                # contract #5: clamp re-arm strictly past now
-                self.wake[i] = NEVER if w >= NEVER else max(w, t + 1)
+                # contract #5: clamp re-arm strictly past the node's now
+                self.wake[i] = NEVER if w >= NEVER else max(w, nexts[i] + 1)
 
-            # route in sender-major order (contract #3)
-            delay, drop = self._vsample(jnp.asarray(out_dst.reshape(-1)),
-                                        jnp.int64(t))
+            # route in chronological (send instant, sender, slot) order
+            # — contract #3; pure sender-major for W == 1. Link entropy
+            # is keyed by each message's own send instant.
+            delay, drop = self._vsample(
+                jnp.asarray(out_dst.reshape(-1)),
+                jnp.asarray(np.repeat(now_arr, M)))
             delay = np.asarray(delay).reshape(n, M)
             drop = np.asarray(drop).reshape(n, M)
             sent_hashes: List[int] = []
             sent_count = 0
             overflow_step = 0
-            for i in fired:
+            for i in sorted(fired, key=lambda i: (nexts[i], i)):
+                ti = nexts[i]
                 for slot in range(M):
                     if not out_valid[i, slot]:
                         continue
@@ -190,13 +224,18 @@ class SuperstepOracle:
                         continue
                     if drop[i, slot]:
                         continue
-                    dt = t + max(int(delay[i, slot]), 1)  # contract #4
+                    flight = max(int(delay[i, slot]), 1)  # contract #4
+                    if W > 1 and flight < W:
+                        # windowed-causality violation — counted loudly,
+                        # mirroring EngineState.short_delay
+                        self.short_delay_total += 1
+                    dt = ti + flight
                     p0 = int(out_pay[i, slot, 0]) if P else 0
                     sent_count += 1
                     sent_hashes.append(mix32_py(
                         SENT, i, dst, dt & _MASK32, dt >> 32, p0))
                     if self.events is not None:
-                        self.events.append(("sent", t, i, dst, dt, p0))
+                        self.events.append(("sent", ti, i, dst, dt, p0))
                     if len(self.mailbox[dst]) >= K:
                         overflow_step += 1  # contract #6: counted, dropped
                     else:

@@ -28,11 +28,9 @@ import jax.numpy as jnp
 from ..core.scenario import NEVER, Inbox, Outbox, Scenario
 from ..core.time import Microsecond, ms, sec
 from ..net.delays import LinkModel, LogNormalDelay
+from .peers import lcg_peers
 
 __all__ = ["gossip", "gossip_links"]
-
-_LCG_A = 1103515245
-_LCG_C = 12345
 
 
 def gossip(n: int, *,
@@ -42,6 +40,7 @@ def gossip(n: int, *,
            bootstrap_us: Microsecond = ms(1),
            end_us: Microsecond = sec(60),
            steady: bool = False,
+           burst: bool = False,
            mailbox_cap: int = 16) -> Scenario:
     """Build the gossip scenario. Node 0 starts infected; the run
     quiesces when every node has relayed its ``fanout`` sends (or the
@@ -51,10 +50,46 @@ def gossip(n: int, *,
     an infected node keeps relaying to one random peer every
     ``gossip_interval`` until the deadline (not fanout-bounded) — the
     classic epidemic steady state, and the dense general-engine
-    regime (every infected node fires co-temporally each round)."""
+    regime (every infected node fires co-temporally each round).
+
+    ``burst=True`` (wave mode only) relays to all ``fanout`` peers in
+    ONE firing after the incubation — how a real node pushes over its
+    parallel peer connections, and the form windowed supersteps can
+    batch (a per-node one-send-per-interval chain is sequential by
+    construction). ``gossip_interval`` is unused then."""
     if n < 2:
         raise ValueError(f"gossip needs n >= 2 nodes, got {n} "
                          "(peer draw divides by n - 1)")
+    if burst and steady:
+        raise ValueError("burst applies to the broadcast wave only; "
+                         "steady mode is round-paced by definition")
+
+    def step_burst(state, inbox: Inbox, now, i, key):
+        hop, lcg = state["hop"], state["lcg"]
+        left, nxt = state["left"], state["next"]
+
+        hin = jnp.min(jnp.where(inbox.valid, inbox.payload[:, 0],
+                                jnp.int32(2**31 - 1)))
+        got_new = (hop < 0) & (hin < 2**31 - 1)
+        hop1 = jnp.where(got_new, hin, hop)
+        alive = now < jnp.int64(end_us)
+        left1 = jnp.where(got_new & alive, jnp.int32(1), left)
+        nxt1 = jnp.where(got_new & alive, now + jnp.int64(think_us), nxt)
+
+        # one firing floods all fanout peers: chained LCG draws
+        due = (left1 > 0) & (nxt1 <= now) & alive
+        lc, dsts = lcg_peers(lcg, i, n, fanout)
+        lcg1 = jnp.where(due, lc, lcg)
+        out = Outbox(
+            valid=jnp.broadcast_to(due, (fanout,)),
+            dst=jnp.stack(dsts),
+            payload=jnp.broadcast_to((hop1 + 1).reshape(1, 1),
+                                     (fanout, 1)))
+        left2 = jnp.where(due, jnp.int32(0), left1)
+        nxt2 = jnp.where(due, jnp.int64(NEVER), nxt1)
+        wake = jnp.where((left2 > 0) & alive, nxt2, jnp.int64(NEVER))
+        return {"hop": hop1, "lcg": lcg1, "left": left2,
+                "next": nxt2}, out, wake
 
     def step(state, inbox: Inbox, now, i, key):
         hop, lcg = state["hop"], state["lcg"]
@@ -70,13 +105,11 @@ def gossip(n: int, *,
         left1 = jnp.where(got_new & alive, jnp.int32(fanout), left)
         nxt1 = jnp.where(got_new & alive, now + jnp.int64(think_us), nxt)
 
-        # one relay send per firing of the relay timer
+        # one relay send per firing of the relay timer (dst is only
+        # observable when due — outbox validity gates it)
         due = (left1 > 0) & (nxt1 <= now) & alive
-        lcg1 = jnp.where(due, lcg * jnp.int32(_LCG_A) + jnp.int32(_LCG_C),
-                         lcg)
-        # peer in [0, n) excluding self
-        dst = (i + jnp.int32(1)
-               + (jnp.abs(lcg1) % jnp.int32(n - 1))) % jnp.int32(n)
+        lc, (dst,) = lcg_peers(lcg, i, n, 1)
+        lcg1 = jnp.where(due, lc, lcg)
         out = Outbox(
             valid=due[None],
             dst=dst[None],
@@ -121,18 +154,20 @@ def gossip(n: int, *,
     return Scenario(
         name=f"gossip-{n}",
         n_nodes=n,
-        step=step,
+        step=step_burst if burst else step,
         init=init,
         init_batched=init_batched,
         payload_width=1,
-        max_out=1,
+        max_out=fanout if burst else 1,
         mailbox_cap=mailbox_cap,
         commutative_inbox=True,
-        meta={"fanout": fanout, "end_us": end_us},
+        meta={"fanout": fanout, "end_us": end_us, "burst": burst},
     )
 
 
 def gossip_links(*, median_us: int = ms(50), sigma: float = 0.6,
-                 cap_us: int = sec(10)) -> LinkModel:
-    """The baseline config's lognormal latency model (net/delays.py)."""
-    return LogNormalDelay(median_us, sigma, cap_us)
+                 cap_us: int = sec(10), floor_us: int = 1) -> LinkModel:
+    """The baseline config's lognormal latency model (net/delays.py).
+    ``floor_us`` adds the propagation-delay floor that licenses
+    windowed supersteps (LogNormalDelay.min_delay_us)."""
+    return LogNormalDelay(median_us, sigma, cap_us, floor_us)

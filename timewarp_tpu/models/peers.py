@@ -1,0 +1,36 @@
+"""Shared pseudo-random peer sampling for the epidemic models.
+
+One in-state LCG per node, advanced once per draw; every draw picks a
+peer in ``[0, n)`` excluding self. Kept as a single helper so the
+gossip and praos models (paced and burst forms) cannot drift apart —
+the draw is part of the deterministic scenario semantics, and all
+interpreters must see identical sequences.
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+import jax.numpy as jnp
+
+__all__ = ["LCG_A", "LCG_C", "lcg_peers"]
+
+LCG_A = 1103515245
+LCG_C = 12345
+
+
+def lcg_peers(lcg, i, n: int, k: int) -> Tuple[jnp.ndarray, List]:
+    """Draw ``k`` chained peers for node ``i`` (scalar, inside vmap).
+
+    Returns ``(lcg_k, [dst_1 … dst_k])`` — the advanced LCG state after
+    ``k`` steps and the destinations, each ``(i + 1 + |lcg_j| % (n-1))
+    % n`` so a node never draws itself. The caller commits ``lcg_k``
+    only when it actually sends (``jnp.where`` on its own gate).
+    """
+    dsts = []
+    lc = lcg
+    for _ in range(k):
+        lc = lc * jnp.int32(LCG_A) + jnp.int32(LCG_C)
+        dsts.append((i + jnp.int32(1)
+                     + (jnp.abs(lc) % jnp.int32(n - 1))) % jnp.int32(n))
+    return lc, dsts

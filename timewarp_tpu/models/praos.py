@@ -29,11 +29,9 @@ import jax.numpy as jnp
 
 from ..core.scenario import NEVER, Inbox, Outbox, Scenario
 from ..core.time import Microsecond, ms, sec
+from .peers import lcg_peers
 
 __all__ = ["praos"]
-
-_LCG_A = 1103515245
-_LCG_C = 12345
 
 
 def praos(n: int, *,
@@ -43,6 +41,7 @@ def praos(n: int, *,
           stake=None,
           fanout: int = 8,
           relay_interval: Microsecond = ms(2),
+          burst: bool = False,
           mailbox_cap: int = 16) -> Scenario:
     """Build the Praos scenario. Quiesces after ``n_slots`` slots once
     the last relay bursts drain. ``leader_prob`` is the per-slot
@@ -50,7 +49,15 @@ def praos(n: int, *,
     block rate is ``sum(stake) * leader_prob`` per slot — keep it ≲ a
     few for realistic fork behavior at scale). ``stake`` (optional
     int array [n]) weights each node's leadership linearly — the
-    "stake nodes" of the baseline config; None = equal stake 1."""
+    "stake nodes" of the baseline config; None = equal stake 1.
+
+    ``burst=True`` pushes a fresh tip to all ``fanout`` peers in ONE
+    firing (outbox width ``fanout``; ``relay_interval`` unused) — how
+    a real node floods its peer set over parallel TCP connections, and
+    the form that lets windowed supersteps batch diffusion (a paced
+    one-send-per-interval chain is a per-node *sequential* dependency
+    no batched executor can collapse). ``burst=False`` keeps the paced
+    bandwidth-limited model."""
     import numpy as _np
 
     if n < 2:
@@ -69,6 +76,40 @@ def praos(n: int, *,
             stake.astype(_np.float64) * leader_prob * 4294967296.0,
             2**32 - 1).astype(_np.uint32)
     thr_j = jnp.asarray(thr_arr)
+
+    def step_burst(state, inbox: Inbox, now, i, key):
+        best, lcg = state["best"], state["lcg"]
+        slot, nslot = state["slot"], state["nslot"]
+
+        # adopt the longest incoming tip (commutative max)
+        tin = jnp.max(jnp.where(inbox.valid, inbox.payload[:, 0],
+                                jnp.int32(-1)))
+        adopt = tin > best
+        best1 = jnp.where(adopt, tin, best)
+
+        # slot boundary: private stake-weighted leadership draw
+        due_slot = (slot < jnp.int32(n_slots)) & (nslot <= now)
+        b0, _ = key
+        leader = due_slot & (b0 < thr_j[i])
+        best2 = best1 + leader.astype(jnp.int32)
+        slot1 = slot + due_slot.astype(jnp.int32)
+        nslot1 = jnp.where(due_slot, nslot + jnp.int64(slot_us), nslot)
+
+        # a fresh tip (adopted or minted) floods all peers at once:
+        # `fanout` chained LCG draws, committed only when fresh
+        fresh = adopt | leader
+        lc, dsts = lcg_peers(lcg, i, n, fanout)
+        lcg1 = jnp.where(fresh, lc, lcg)
+        pay = jnp.stack([best2, i])
+        out = Outbox(
+            valid=jnp.broadcast_to(fresh, (fanout,)),
+            dst=jnp.stack(dsts),
+            payload=jnp.broadcast_to(pay, (fanout, 2)))
+
+        wake = jnp.where(slot1 < jnp.int32(n_slots), nslot1,
+                         jnp.int64(NEVER))
+        return {"best": best2, "lcg": lcg1, "slot": slot1,
+                "nslot": nslot1}, out, wake
 
     def step(state, inbox: Inbox, now, i, key):
         best, lcg = state["best"], state["lcg"]
@@ -95,12 +136,11 @@ def praos(n: int, *,
         left1 = jnp.where(fresh, jnp.int32(fanout), left)
         nrelay1 = jnp.where(fresh, now + jnp.int64(relay_interval), nrelay)
 
-        # one relay send per firing of the relay timer
+        # one relay send per firing of the relay timer (dst observable
+        # only when due_relay — outbox validity gates it)
         due_relay = (left1 > 0) & (nrelay1 <= now)
-        lcg1 = jnp.where(due_relay,
-                         lcg * jnp.int32(_LCG_A) + jnp.int32(_LCG_C), lcg)
-        dst = (i + jnp.int32(1)
-               + (jnp.abs(lcg1) % jnp.int32(n - 1))) % jnp.int32(n)
+        lc, (dst,) = lcg_peers(lcg, i, n, 1)
+        lcg1 = jnp.where(due_relay, lc, lcg)
         out = Outbox(
             valid=due_relay[None],
             dst=dst[None],
@@ -118,14 +158,16 @@ def praos(n: int, *,
                 "nslot": nslot1}, out, wake
 
     def init(i: int):
-        return {
+        st = {
             "best": jnp.int32(0),
             "lcg": jnp.int32((i * 2654435761) % (2**31 - 1) + 1),
-            "left": jnp.int32(0),
-            "nrelay": jnp.int64(NEVER),
             "slot": jnp.int32(0),
             "nslot": jnp.int64(slot_us),
-        }, slot_us
+        }
+        if not burst:
+            st["left"] = jnp.int32(0)
+            st["nrelay"] = jnp.int64(NEVER)
+        return st, slot_us
 
     def init_batched(nn: int):
         ids = jnp.arange(nn, dtype=jnp.int32)
@@ -134,24 +176,26 @@ def praos(n: int, *,
             "best": jnp.zeros(nn, jnp.int32),
             "lcg": ((ids.astype(jnp.int64) * 2654435761)
                     % (2**31 - 1) + 1).astype(jnp.int32),
-            "left": jnp.zeros(nn, jnp.int32),
-            "nrelay": jnp.full(nn, NEVER, jnp.int64),
             "slot": jnp.zeros(nn, jnp.int32),
             "nslot": jnp.full(nn, slot_us, jnp.int64),
         }
+        if not burst:
+            states["left"] = jnp.zeros(nn, jnp.int32)
+            states["nrelay"] = jnp.full(nn, NEVER, jnp.int64)
         return states, wake
 
     return Scenario(
         name=f"praos-{n}",
         n_nodes=n,
-        step=step,
+        step=step_burst if burst else step,
         init=init,
         init_batched=init_batched,
         payload_width=2,
-        max_out=1,
+        max_out=fanout if burst else 1,
         mailbox_cap=mailbox_cap,
         needs_key=True,
         commutative_inbox=True,
         meta={"slot_us": slot_us, "n_slots": n_slots,
-              "leader_prob": leader_prob, "fanout": fanout},
+              "leader_prob": leader_prob, "fanout": fanout,
+              "burst": burst},
     )

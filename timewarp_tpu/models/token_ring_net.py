@@ -15,7 +15,7 @@ The delays spec reproduces Main.hs:73-77: observer-bound messages are
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Any, List, Tuple
+from typing import Any, List, Optional, Tuple
 
 import jax.numpy as jnp
 
@@ -96,11 +96,27 @@ def token_ring_net(backend: NetBackend, n_nodes: int = 3, *,
                    passing_delay_us: int = sec(3),
                    bootstrap_us: int = sec(1),
                    check_period_us: int = sec(1),
-                   allowed_progress_delay_us: int = sec(5)):
+                   allowed_progress_delay_us: int = sec(5),
+                   prewarm: bool = False,
+                   bootstrap_at: bool = False,
+                   receipts: Optional[List[Tuple[int, int, int]]] = None):
     """Build the scenario main program (defaults = the reference's
     launch parameters, Main.hs:36-52). Returns
     ``(observer_notes, errors)``: the ``(time, value)`` list the
-    observer recorded, and any wrong-value/stall errors it flagged."""
+    observer recorded, and any wrong-value/stall errors it flagged.
+
+    Cross-world-parity knobs (tests/test_cross_world.py — aligning this
+    generator-program world with the batched Scenario world µs-for-µs):
+
+    - ``prewarm``: each node opens its successor/observer connections at
+      launch (persistent connections, as real deployments keep), so the
+      connect handshake is off the steady-state timing path;
+    - ``bootstrap_at``: anchor the first token at absolute virtual time
+      ``bootstrap_us`` (``at``) instead of the reference's relative
+      ``after`` (Main.hs:131-135), removing the few-µs fork-setup skew;
+    - ``receipts``: optional sink recording ``(time, node, value)`` at
+      each worker's token receipt.
+    """
     notes: List[Tuple[int, int]] = []
     errors: List[str] = []
     cleanups: List[Any] = []
@@ -115,6 +131,9 @@ def token_ring_net(backend: NetBackend, n_nodes: int = 3, *,
 
         def on_value_received(v: int) -> Program:
             # ≙ onValueReceived (Main.hs:137-141)
+            if receipts is not None:
+                t = yield GetTime()
+                receipts.append((t, no, v))
             yield from rpc.call(observer_addr, NoteToken(v))
             yield Wait(int(passing_delay_us))
             yield from rpc.call(successor_addr, PassToken(v + 1))
@@ -144,11 +163,20 @@ def token_ring_net(backend: NetBackend, n_nodes: int = 3, *,
         yield from schedule(at(int(duration_us)),
                             lambda: kill_thread(wtid))
 
+        if prewarm:
+            # open the persistent connections and attach the response
+            # listeners now, so neither the connect handshake nor the
+            # listener-attach forks sit on the steady-state timing path
+            yield from rpc.prepare(successor_addr)
+            yield from rpc.prepare(observer_addr)
+
         if no == 1:
             # ≙ bootstrap (Main.hs:131-147)
             def create_token() -> Program:
                 yield from rpc.call(successor_addr, PassToken(1))
-            yield from invoke(after(int(bootstrap_us)), create_token)
+            spec = (at(int(bootstrap_us)) if bootstrap_at
+                    else after(int(bootstrap_us)))
+            yield from invoke(spec, create_token)
 
     def launch_observer() -> Program:
         # ≙ launchObserver (Main.hs:167-208)

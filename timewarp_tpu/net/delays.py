@@ -50,6 +50,16 @@ class LinkModel:
         """-> (delay int64 µs, drop bool)."""
         raise NotImplementedError
 
+    @property
+    def min_delay_us(self) -> int:
+        """Static lower bound on every delay this model can sample
+        (after the engine's ≥1 µs clamp, contract #4). Multi-instant
+        windowed supersteps are exact only for window ≤ this bound —
+        engines validate against it (interp/jax_engine/engine.py) and
+        count dynamic violations in ``short_delay``, never silent.
+        Conservative default: 1 µs (no windowing headroom)."""
+        return 1
+
 
 @dataclass(frozen=True)
 class FixedDelay(LinkModel):
@@ -60,6 +70,10 @@ class FixedDelay(LinkModel):
     def sample(self, src, dst, t, key):
         d = jnp.full(jnp.shape(dst), self.delay, jnp.int64)
         return d, jnp.zeros(jnp.shape(dst), bool)
+
+    @property
+    def min_delay_us(self) -> int:
+        return max(int(self.delay), 1)
 
 
 @dataclass(frozen=True)
@@ -75,11 +89,19 @@ class UniformDelay(LinkModel):
         return uniform_int(b0, self.lo, self.hi), \
             jnp.zeros(jnp.shape(dst), bool)
 
+    @property
+    def min_delay_us(self) -> int:
+        return max(int(self.lo), 1)
+
 
 @dataclass(frozen=True)
 class LogNormalDelay(LinkModel):
     """Lognormal latency (the gossip-100k baseline config): delay =
-    round(median * exp(sigma * N(0,1))), capped to [1, cap] µs.
+    round(median * exp(sigma * N(0,1))), capped to [floor, cap] µs.
+
+    ``floor_us`` models the propagation-delay floor every real network
+    has (a packet can't beat the speed of light); it is also the bound
+    that licenses multi-instant windowed supersteps (``min_delay_us``).
 
     Float32 internally; quantized to µs. Bit-parity is validated on CPU;
     across CPU/TPU a boundary-rounding µs divergence is possible in
@@ -89,15 +111,20 @@ class LogNormalDelay(LinkModel):
     median_us: int
     sigma: float
     cap_us: int = 60_000_000
+    floor_us: int = 1
 
     def sample(self, src, dst, t, key):
         b0, b1 = key
         z = normal_f32(b0, b1)
         d = jnp.asarray(self.median_us, jnp.float32) * jnp.exp(
             jnp.float32(self.sigma) * z)
-        d = jnp.clip(d, 1.0, jnp.float32(self.cap_us))
+        d = jnp.clip(d, jnp.float32(self.floor_us), jnp.float32(self.cap_us))
         return jnp.asarray(jnp.round(d), jnp.int64), \
             jnp.zeros(jnp.shape(dst), bool)
+
+    @property
+    def min_delay_us(self) -> int:
+        return max(int(self.floor_us), 1)
 
 
 @dataclass(frozen=True)
@@ -115,6 +142,10 @@ class WithDrop(LinkModel):
         inner_key = split_bits(b0, b1, 0x1A7E5EED)
         delay, inner_drop = self.inner.sample(src, dst, t, inner_key)
         return delay, drop | inner_drop
+
+    @property
+    def min_delay_us(self) -> int:
+        return self.inner.min_delay_us
 
 
 @dataclass(frozen=True)
@@ -142,6 +173,12 @@ class Quantize(LinkModel):
         d, drop = self.inner.sample(src, dst, t, key)
         q = jnp.int64(self.quantum_us)
         return ((d + q - 1) // q) * q, drop
+
+    @property
+    def min_delay_us(self) -> int:
+        q = int(self.quantum_us)
+        m = self.inner.min_delay_us
+        return ((m + q - 1) // q) * q
 
 
 @dataclass(frozen=True)

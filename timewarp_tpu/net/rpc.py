@@ -179,6 +179,16 @@ class Rpc:
             raise payload
         raise RpcError(payload.text)
 
+    def prepare(self, addr: NetworkAddress) -> Program:
+        """Persistent-connection warm-up: eagerly open the pooled
+        connection to ``addr`` and attach the response listener. After
+        this, a ``call``'s request chunk leaves the socket at the same
+        virtual-time instant the call is issued — neither the connect
+        handshake nor the listener-attach forks sit on the timing path
+        (load-bearing for cross-world trace alignment,
+        tests/test_cross_world.py)."""
+        yield from self._ensure_response_listener(addr)
+
     def _ensure_response_listener(self, addr: NetworkAddress) -> Program:
         """Attach (once per live connection) a raw listener on the
         outbound connection that routes ``s``/``e``/``x`` responses to

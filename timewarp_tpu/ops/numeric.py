@@ -26,13 +26,16 @@ def group_rank(sorted_keys: jax.Array) -> jax.Array:
     Replaces ``searchsorted(keys, keys, 'left')`` in the routing path —
     on TPU searchsorted lowers to ~log2(S) chained gather rounds
     (~1 ms each at 131k elements, profiling/superstep_breakdown.md)
-    while the associative cummax scan is elementwise-cheap."""
+    while the cummax scan is elementwise-cheap. Uses the ``lax.cummax``
+    primitive: the hand-rolled ``associative_scan(maximum, …)`` tree it
+    replaces wedged the TPU compile service for minutes-to-forever at
+    S ≥ ~4M (slice/concat-heavy recursive lowering), while the
+    primitive compiles in seconds and runs ~0.1 s at 16M."""
     S = sorted_keys.shape[0]
     iota = jnp.arange(S, dtype=jnp.int32)
     boundary = jnp.concatenate([
         jnp.ones((1,), bool), sorted_keys[1:] != sorted_keys[:-1]])
-    first = jax.lax.associative_scan(
-        jnp.maximum, jnp.where(boundary, iota, 0))
+    first = jax.lax.cummax(jnp.where(boundary, iota, 0))
     return iota - first
 
 
