@@ -70,7 +70,10 @@ def test_windowed_engine_matches_windowed_oracle(mk):
 def test_windowed_equals_classic_semantics(mk):
     """The exactness law: windowing changes superstep granularity, not
     event semantics. Run to quiescence both ways; everything observable
-    must coincide."""
+    must coincide. (Exactness additionally requires the classic run to
+    be overflow-free — the deliver-then-insert overflow-boundary caveat
+    in the JaxEngine docstring — which the overflow equality below
+    also certifies for these workloads.)"""
     sc = mk()
     e1 = JaxEngine(sc, LINK, window=1)
     ew = JaxEngine(sc, LINK, window=W)

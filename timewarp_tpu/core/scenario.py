@@ -118,6 +118,15 @@ class Scenario:
     #: contract-#2 inbox sort; parity still holds bit-for-bit because
     #: digests are order-independent and the step result is too.
     commutative_inbox: bool = False
+    #: False when ``step`` never reads ``inbox.src`` (sender identity
+    #: is not part of the scenario's semantics — e.g. a gossip adopt is
+    #: a pure payload reduction). Engines then skip storing/scattering
+    #: the mailbox src field (mailbox scatters are the dense
+    #: random-delivery cost floor on TPU, PERF_r04.md), ``inbox.src``
+    #: reads as 0, and ALL interpreters hash src as 0 in the RECV
+    #: digest — the parity law still pins every delivered message's
+    #: (dst, time, payload), just not its sender.
+    inbox_src: bool = True
     #: metadata for bench/trace tooling
     meta: dict = field(default_factory=dict)
 

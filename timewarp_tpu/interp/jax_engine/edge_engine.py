@@ -306,7 +306,10 @@ class EdgeEngine:
                           jnp.int64(NEVER))
         inbox = Inbox(
             valid=iv,
-            src=jnp.where(iv, isrc, 0),
+            # inbox_src=False scenarios never read src: all
+            # interpreters present 0 (core/scenario.py)
+            src=jnp.where(iv, isrc, 0) if sc.inbox_src
+            else jnp.zeros_like(isrc),
             time=itime,
             payload=jnp.where(iv[:, None, :], ipay, 0),
         )
@@ -427,10 +430,11 @@ class EdgeEngine:
         fired_hash = comm.all_sum(
             _u32sum(jnp.where(fire, mix32_jnp(FIRED, node_ids), 0)))
         d_abs = base + jnp.where(deliver, st.q_rel, 0).astype(jnp.int64)
+        rsrc = (jnp.broadcast_to(src_rows[:, None, :], (E, C, n))
+                if sc.inbox_src else jnp.zeros((E, C, n), jnp.int32))
         rmix = mix32_jnp(
             RECV, jnp.broadcast_to(node_ids, (E, C, n)),
-            jnp.broadcast_to(src_rows[:, None, :], (E, C, n)),
-            _tlo(d_abs), _thi(d_abs), st.q_pay[:, :, 0, :])
+            rsrc, _tlo(d_abs), _thi(d_abs), st.q_pay[:, :, 0, :])
         recv_hash = comm.all_sum(_u32sum(jnp.where(deliver, rmix, 0)))
         yrow = _StepOut(
             valid=live, t=t,

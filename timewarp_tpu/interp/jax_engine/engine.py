@@ -103,8 +103,16 @@ class JaxEngine:
     (per-node ``now``; per-instant entropy; wake clamp past the node's
     own instant). This is *exact* — identical event semantics to
     window=1, superstep granularity aside — when every link delay is
-    ≥ ``window``: an in-window send then arrives at or past the window
-    end, so in-window firings are causally independent. The constructor
+    ≥ ``window`` (an in-window send then arrives at or past the window
+    end, so in-window firings are causally independent) AND the
+    window=1 run is overflow-free. The overflow caveat: a windowed
+    superstep delivers before it inserts, so a mailbox that stands at
+    capacity in the classic run until a later in-window firing drains
+    it can reject a message under window=1 yet accept it windowed —
+    overflow-*boundary* behavior, never event reordering; with zero
+    overflow the two runs coincide message-for-message (the windowed
+    oracle mirrors the same deliver-then-insert order, so
+    engine ≡ oracle parity holds unconditionally). The constructor
     validates ``window <= link.min_delay_us`` (net/delays.py), and any
     dynamically sampled shorter delay is counted in
     ``EngineState.short_delay`` (a nonzero count marks the run as
@@ -253,7 +261,8 @@ class JaxEngine:
         if sc.commutative_inbox:
             inbox = Inbox(
                 valid=deliver,
-                src=jnp.where(deliver, st.mb_src, 0),
+                src=jnp.where(deliver, st.mb_src, 0) if sc.inbox_src
+                else jnp.zeros_like(st.mb_src),
                 time=jnp.where(deliver,
                                base + st.mb_rel.astype(jnp.int64),
                                jnp.int64(NEVER)),
@@ -272,7 +281,8 @@ class JaxEngine:
             # function cannot diverge between interpreters
             inbox = Inbox(
                 valid=ib_valid,
-                src=jnp.where(ib_valid, ib_src, 0),
+                src=jnp.where(ib_valid, ib_src, 0) if sc.inbox_src
+                else jnp.zeros_like(ib_src),
                 time=jnp.where(ib_valid, base + ib_rel.astype(jnp.int64),
                                jnp.int64(NEVER)),
                 payload=jnp.where(ib_valid[:, None, :], ib_pay, 0),
@@ -421,7 +431,11 @@ class JaxEngine:
             col = jnp.clip(pos, 0, K - 1)
         row = jnp.where(fits, sd, n)  # out-of-range row -> dropped scatter
         mb_rel = mb_rel.at[col, row].set(drel_s, mode="drop")
-        mb_src = mb_src.at[col, row].set(src_s, mode="drop")
+        if sc.inbox_src:
+            # inbox_src=False skips this whole scatter — mailbox
+            # scatters ARE the dense random-delivery cost floor
+            # (PERF_r04.md), so dropping an unread field is ~1/3 of it
+            mb_src = mb_src.at[col, row].set(src_s, mode="drop")
         for p in range(P):
             mb_payload = mb_payload.at[col, p, row].set(
                 ops3[3 + p], mode="drop")
@@ -455,7 +469,8 @@ class JaxEngine:
         d_abs = base + jnp.where(deliver, st.mb_rel, 0).astype(jnp.int64)
         recv_mix = mix32_jnp(
             RECV, jnp.broadcast_to(node_ids[None, :], (K, n)),
-            st.mb_src, _tlo(d_abs), _thi(d_abs),
+            st.mb_src if sc.inbox_src else jnp.zeros_like(st.mb_src),
+            _tlo(d_abs), _thi(d_abs),
             st.mb_payload[:, 0, :])
         recv_hash = comm.all_sum(_u32sum(jnp.where(deliver, recv_mix, 0)))
         dt_abs = t + drel64  # == send instant + flight time

@@ -165,10 +165,14 @@ class SuperstepOracle:
                 for j, (m, _) in enumerate(picked):
                     ib_valid[i, j] = True
                     ib_time[i, j] = m[0]
-                    ib_src[i, j] = m[1]
+                    # inbox_src=False: sender identity is not part of
+                    # the scenario semantics — all interpreters present
+                    # (and hash) 0 (core/scenario.py)
+                    src_word = m[1] if sc.inbox_src else 0
+                    ib_src[i, j] = src_word
                     ib_pay[i, j] = m[2]
                     recv_hashes.append(mix32_py(
-                        RECV, i, m[1], m[0] & _MASK32, m[0] >> 32,
+                        RECV, i, src_word, m[0] & _MASK32, m[0] >> 32,
                         int(m[2][0]) if P else 0))
                     if self.events is not None:
                         self.events.append(

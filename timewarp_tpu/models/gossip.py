@@ -161,6 +161,9 @@ def gossip(n: int, *,
         max_out=fanout if burst else 1,
         mailbox_cap=mailbox_cap,
         commutative_inbox=True,
+        # the adopt is a pure min-reduction over payloads: sender
+        # identity is never read, so engines skip the mb_src scatter
+        inbox_src=False,
         meta={"fanout": fanout, "end_us": end_us, "burst": burst},
     )
 

@@ -195,6 +195,10 @@ def praos(n: int, *,
         mailbox_cap=mailbox_cap,
         needs_key=True,
         commutative_inbox=True,
+        # the adopt is a pure max-reduction over tip lengths and the
+        # relayer id travels in payload[:, 1] — inbox.src is never
+        # read, so engines skip the mb_src scatter (PERF_r04.md)
+        inbox_src=False,
         meta={"slot_us": slot_us, "n_slots": n_slots,
               "leader_prob": leader_prob, "fanout": fanout,
               "burst": burst},
