@@ -40,6 +40,20 @@ def test_token_ring_engine_example():
     assert "messages delivered" in out
 
 
+def test_playground_example_all_scenarios():
+    out = run_example("examples/playground.py")
+    assert "generation 2 stopped; port re-binds cleanly" in out
+    assert "content never parsed" in out                   # proxy
+    assert "finally received b'patience pays'" in out      # slowpoke
+    assert "yo-ho-ho" in out                               # yohoho reply
+    assert "forked EpicRequest" in out                     # fork strategy
+
+
+def test_playground_single_scenario_flag():
+    out = run_example("examples/playground.py", "--scenario", "proxy")
+    assert "via proxy" in out and "yo-ho-ho" not in out
+
+
 def test_profiling_script_runs():
     out = run_example("profiling/profile_superstep.py", timeout=300,
                       env_extra={"TW_PROF_NODES": "512",
