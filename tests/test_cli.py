@@ -40,6 +40,31 @@ def test_cli_oracle_and_engines_agree(capsys):
     assert rows["general"]["supersteps"] == rows["edge"]["supersteps"]
 
 
+def test_cli_windowed_burst_oracle_engine_agree(capsys):
+    common = ["gossip", "--nodes", "48", "--burst", "--fanout", "4",
+              "--window", "2000",
+              "--link", "quantize:1000:uniform:2000:8000",
+              "--steps", "300", "--end-us", "300000"]
+    rows = {
+        "oracle": run_cli(capsys, *common, "--engine", "oracle"),
+        # route_cap is a general-engine knob (the oracle CLI rejects it)
+        "general": run_cli(capsys, *common, "--engine", "general",
+                           "--route-cap", "192"),
+    }
+    assert rows["oracle"]["delivered"] == rows["general"]["delivered"]
+    assert rows["oracle"]["supersteps"] == rows["general"]["supersteps"]
+
+
+def test_cli_rejects_ignored_knobs():
+    import pytest
+
+    from timewarp_tpu.cli import main
+    with pytest.raises(SystemExit, match="general engines only"):
+        main(["token-ring", "--engine", "edge", "--window", "3000"])
+    with pytest.raises(SystemExit, match="general engines only"):
+        main(["token-ring", "--engine", "oracle", "--route-cap", "8"])
+
+
 def test_cli_sharded_engines(capsys):
     r = run_cli(capsys, "gossip", "--nodes", "64", "--engine", "sharded",
                 "--devices", "8", "--steps", "150",

@@ -88,3 +88,36 @@ def test_randomized_scenario_parity(data):
     ot2 = SuperstepOracle(sc2, link, seed=seed).run(4_000)
     _, et = EdgeEngine(sc2, link, seed=seed, cap=6).run(160)
     assert_traces_equal(ot2, et, "oracle", "edge", limit=len(et))
+
+
+@settings(max_examples=15, deadline=None)
+@given(data=st.data())
+def test_randomized_windowed_parity(data):
+    """The windowed path under randomized timers/links: engine ≡
+    windowed oracle bit-for-bit for any window ≤ the link's declared
+    delay floor, with and without a route_cap."""
+    from timewarp_tpu.net.delays import Quantize
+
+    rng = np.random.default_rng(data.draw(st.integers(0, 2**31)))
+    periods = rng.integers(300, 4_000, N)
+    commutative = bool(data.draw(st.booleans()))
+    lo = int(rng.integers(2_000, 5_000))
+    hi = lo + int(rng.integers(1, 6_000))
+    link = Quantize(UniformDelay(lo, hi), 1_000)
+    W = int(data.draw(st.sampled_from([2, 3])) ) * 1_000
+    W = min(W, link.min_delay_us)
+    seed = int(data.draw(st.integers(0, 1000)))
+    cap = data.draw(st.sampled_from([None, N]))  # N < S: slicing active
+
+    sc = _rand_scenario(periods, rng.integers(0, N, N), 25_000,
+                        commutative)
+    ot = SuperstepOracle(sc, link, seed=seed, window=W).run(4_000)
+    st_, gt = JaxEngine(sc, link, seed=seed, window=W,
+                        route_cap=cap).run(160)
+    assert_traces_equal(ot, gt, "windowed-oracle", "windowed-general",
+                        limit=len(gt))
+    assert int(st_.short_delay) == 0
+    if cap is not None:
+        # cap == N ≥ the per-superstep active count (each node sends
+        # at most 1 message per firing), so slicing must be a no-op
+        assert int(st_.route_drop) == 0

@@ -55,12 +55,12 @@ def build_scenario(args):
         from .models.gossip import gossip
         return gossip(args.nodes, fanout=args.fanout,
                       end_us=args.end_us, steady=args.steady,
-                      mailbox_cap=args.mailbox_cap)
+                      burst=args.burst, mailbox_cap=args.mailbox_cap)
     if args.scenario == "praos":
         from .models.praos import praos
         return praos(args.nodes, n_slots=args.slots,
                      leader_prob=args.leader_prob, fanout=args.fanout,
-                     mailbox_cap=args.mailbox_cap)
+                     burst=args.burst, mailbox_cap=args.mailbox_cap)
     if args.scenario == "ping-pong":
         from .models.ping_pong import ping_pong
         return ping_pong(rounds=args.tokens or 10)
@@ -68,12 +68,25 @@ def build_scenario(args):
 
 
 def build_engine(args, sc, link):
+    # never-silent: reject knobs an engine would ignore rather than
+    # letting cross-engine comparisons diverge mysteriously
+    if args.engine in ("edge", "sharded-edge") and args.window != 1:
+        raise SystemExit(
+            f"--window applies to the general engines only; "
+            f"{args.engine} runs classic supersteps")
+    if (args.engine in ("oracle", "edge", "sharded-edge")
+            and args.route_cap is not None):
+        raise SystemExit(
+            f"--route-cap applies to the general engines only; "
+            f"{args.engine} has no insertion stage to bound")
     if args.engine == "oracle":
         from .interp.ref.superstep import SuperstepOracle
-        return SuperstepOracle(sc, link, seed=args.seed)
+        return SuperstepOracle(sc, link, seed=args.seed,
+                               window=args.window)
     if args.engine == "general":
         from .interp.jax_engine.engine import JaxEngine
-        return JaxEngine(sc, link, seed=args.seed)
+        return JaxEngine(sc, link, seed=args.seed, window=args.window,
+                         route_cap=args.route_cap)
     if args.engine == "edge":
         from .interp.jax_engine.edge_engine import EdgeEngine
         return EdgeEngine(sc, link, seed=args.seed, cap=args.edge_cap)
@@ -85,7 +98,8 @@ def build_engine(args, sc, link):
                else ShardedEngine)
         if cls is ShardedEdgeEngine:
             return cls(sc, link, mesh, seed=args.seed, cap=args.edge_cap)
-        return cls(sc, link, mesh, seed=args.seed)
+        return cls(sc, link, mesh, seed=args.seed, window=args.window,
+                   route_cap=args.route_cap)
     raise SystemExit(f"unknown engine {args.engine!r}")
 
 
@@ -117,6 +131,15 @@ def main(argv=None) -> int:
     p.add_argument("--observer", action="store_true")
     p.add_argument("--steady", action="store_true",
                    help="gossip: rumor-mongering steady state")
+    p.add_argument("--burst", action="store_true",
+                   help="gossip/praos: flood all fanout peers in one "
+                        "firing (the windowed-superstep-friendly form)")
+    p.add_argument("--window", type=int, default=1,
+                   help="multi-instant superstep window in µs "
+                        "(requires link min delay >= window)")
+    p.add_argument("--route-cap", type=int, default=None,
+                   help="static active-message budget for the insertion "
+                        "stage (clipped messages are counted)")
     p.add_argument("--fanout", type=int, default=8)
     p.add_argument("--slots", type=int, default=10)
     p.add_argument("--leader-prob", type=float, default=0.05)

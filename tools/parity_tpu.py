@@ -9,7 +9,9 @@ models, so equality is exact across backends (core/rng.py, SURVEY.md
 
 Configs: ping-pong (BASELINE config 1), token-ring 64 fixed-latency
 (config 2, edge engine), token-ring 64 w/ observer + uniform links
-(general engine), gossip-64 w/ drops (all integers).
+(general engine), gossip-64 w/ drops, plus the round-4 execution modes:
+burst-gossip under a multi-instant window and burst-praos under a
+window with route_cap (all integer link models).
 
 Usage: ``python tools/parity_tpu.py`` (writes PARITY_TPU.json at the
 repo root). Exits nonzero on any trace mismatch. If no accelerator is
@@ -44,38 +46,52 @@ def main() -> int:
     from timewarp_tpu.interp.ref.superstep import SuperstepOracle
     from timewarp_tpu.models.gossip import gossip
     from timewarp_tpu.models.ping_pong import ping_pong
+    from timewarp_tpu.models.praos import praos
     from timewarp_tpu.models.token_ring import token_ring, token_ring_links
     from timewarp_tpu.net.delays import (
-        FixedDelay, UniformDelay, WithDrop)
+        FixedDelay, Quantize, UniformDelay, WithDrop)
     from timewarp_tpu.trace.events import TraceMismatch, assert_traces_equal
 
     platform = jax.devices()[0].platform
     cpu = jax.devices("cpu")[0]
 
+    wlink = Quantize(UniformDelay(3_000, 9_000), 1_000)  # min delay 3 ms
     configs = {
         "ping-pong": (
             ping_pong(rounds=50), UniformDelay(500, 2_000),
-            JaxEngine, 400),
+            JaxEngine, 400, {}),
         "token-ring-64-fixed": (
             token_ring(64, n_tokens=16, think_us=2_000, bootstrap_us=1000,
                        end_us=400_000, with_observer=False, mailbox_cap=6),
-            FixedDelay(1_500), EdgeEngine, 600),
+            FixedDelay(1_500), EdgeEngine, 600, {}),
         "token-ring-64-observer": (
             token_ring(64, n_tokens=8, think_us=3_000, bootstrap_us=1000,
                        end_us=300_000, with_observer=True, mailbox_cap=16),
-            token_ring_links(64), JaxEngine, 600),
+            token_ring_links(64), JaxEngine, 600, {}),
         "gossip-64-drop": (
             gossip(64, fanout=6, think_us=3_000, gossip_interval=1_000,
                    end_us=5_000_000),
-            WithDrop(UniformDelay(2_000, 30_000), 0.15), JaxEngine, 800),
+            WithDrop(UniformDelay(2_000, 30_000), 0.15), JaxEngine, 800, {}),
+        # round-4 execution modes: multi-instant windows, burst
+        # diffusion, route_cap — the sparse-regime machinery, proven on
+        # the real chip
+        "gossip-64-burst-windowed": (
+            gossip(64, fanout=4, think_us=700, burst=True,
+                   end_us=400_000, mailbox_cap=16),
+            wlink, JaxEngine, 600, {"window": 3_000}),
+        "praos-48-burst-windowed-routecap": (
+            praos(48, slot_us=20_000, n_slots=6, leader_prob=2.0 / 48,
+                  fanout=4, burst=True, mailbox_cap=16),
+            wlink, JaxEngine, 600, {"window": 3_000, "route_cap": 96}),
     }
 
     out = {"engine_platform": platform, "oracle_platform": "cpu",
            "configs": {}, "ok": True}
-    for name, (sc, link, eng_cls, steps) in configs.items():
+    for name, (sc, link, eng_cls, steps, ekw) in configs.items():
         with jax.default_device(cpu):
-            otrace = SuperstepOracle(sc, link).run(20 * steps)
-        engine = eng_cls(sc, link)
+            otrace = SuperstepOracle(
+                sc, link, window=ekw.get("window", 1)).run(20 * steps)
+        engine = eng_cls(sc, link, **ekw)
         _, etrace = engine.run(steps)
         entry = {
             "supersteps": len(etrace),
