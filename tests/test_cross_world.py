@@ -166,6 +166,29 @@ def test_batched_engine_matches_oracle(batched_world):
     assert int(state.bad_dst) == 0
 
 
+def test_net_world_values_under_real_asyncio():
+    """The third interpreter leg: the SAME generator program runs under
+    real wall-clock asyncio (over the emulated fabric, scaled to ms so
+    the test stays fast). Wall-clock jitter forbids µs assertions, but
+    the application-level *value* stream and its monotone order — what
+    the reference's observer checks (Main.hs:197-208) — must match the
+    other two worlds exactly."""
+    from timewarp_tpu import run_real_time
+
+    receipts = []
+    backend = EmulatedBackend(_net_delays(), seed=0)
+    notes, errors = run_real_time(token_ring_net(
+        backend, 8, duration_us=300_000,      # 0.3 s wall
+        passing_delay_us=30_000, bootstrap_us=20_000,
+        check_period_us=50_000, prewarm=True, receipts=receipts))
+    assert errors == []
+    assert [v for _, v in notes] == list(range(1, len(notes) + 1))
+    assert len(notes) >= 4
+    assert [v for _, _, v in receipts] == [v for _, v in notes]
+    # receipt nodes walk the ring: value v lands on node (v mod 8) + 1
+    assert all(node == v % 8 + 1 for _, node, v in receipts)
+
+
 def test_hand_rolled_trace_matches_both_engines_and_oracle():
     """Engine-independent oracle for the dense 64-ring (VERDICT r3
     Missing #2): predict the FULL superstep trace — times, counts, and

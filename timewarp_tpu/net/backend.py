@@ -109,6 +109,27 @@ class NetBackend:
 _EOF = object()    # clean FIN
 _BREAK = object()  # abrupt reset
 
+#: link-model -> jitted sampler; models are frozen dataclasses
+#: (hashable), so equal models share one XLA compilation process-wide
+_DRAW_CACHE: Dict[Any, Any] = {}
+
+
+def _jitted_draw(model: "LinkModel"):
+    fn = _DRAW_CACHE.get(model)
+    if fn is None:
+        import jax
+
+        from ..core.rng import msg_bits
+
+        def sample(s0, s1, src, dst, t, slot):
+            key = msg_bits(s0, s1, src, dst, t, slot) \
+                if model.needs_key else None
+            return model.sample(src, dst, t, key)
+
+        fn = jax.jit(sample)
+        _DRAW_CACHE[model] = fn
+    return fn
+
 
 class _Pipe(_Waitable):
     """One direction of an emulated connection: a queue of
@@ -248,16 +269,29 @@ class EmulatedBackend(NetBackend):
         self._ports: Dict[NetworkAddress, _EmuListener] = {}
         self._conn_seq: Dict[Tuple[int, int], int] = {}
         self._ephemeral = 49152
+        # warm the sampler compilations NOW: a lazy first-draw compile
+        # (~150 ms) inside the asyncio loop would starve ms-scale
+        # timers under the real-time interpreter
+        for model in {self._delays, self._cdelays}:
+            self._draw(model, 0, 0, 0, 0)
 
     # -- rng -------------------------------------------------------------
 
     def _draw(self, model: LinkModel, src: int, dst: int, t: int,
               slot: int) -> Tuple[int, bool]:
-        from ..core.rng import msg_bits
-        key = None
-        if model.needs_key:
-            key = msg_bits(self._s0, self._s1, src, dst, t, slot)
-        delay, drop = model.sample(src, dst, t, key)
+        """One per-chunk link sample, jit-compiled once per *model*
+        (module-scope cache; seeds are runtime args, so every backend
+        and every seed shares one compilation): the counter-hash chain
+        is ~60 elementwise jnp ops, and dispatching them un-jitted
+        costs real wall-clock per chunk — harmless to the virtual clock
+        of the pure emulator, but enough to starve ms-scale timers
+        under the real-time interpreter (and worse through a
+        remote-device tunnel)."""
+        import jax.numpy as jnp
+        delay, drop = _jitted_draw(model)(
+            jnp.uint32(self._s0), jnp.uint32(self._s1),
+            jnp.uint32(src), jnp.uint32(dst),
+            jnp.int64(t), jnp.uint32(slot))
         return max(int(delay), 1), bool(drop)
 
     def _sample(self, src: int, dst: int, t: int,
