@@ -162,11 +162,20 @@ def test_route_cap_exact_when_under_and_counted_when_over():
     assert int(st.delivered) < otrace.total_delivered()
 
 
-def test_windowed_sharded_parity():
-    """8-device all_to_all engine reproduces the windowed trace."""
+@pytest.mark.parametrize("mesh_spec", [
+    pytest.param((8, None), id="1axis-8dev"),
+    pytest.param(((2, 4), ("dcn", "ici")), id="2axis-dcn-ici"),
+])
+def test_windowed_sharded_parity(mesh_spec):
+    """The all_to_all engine reproduces the windowed trace on a flat
+    8-device mesh AND on a multi-slice (dcn, ici) mesh shape — the
+    window offsets ride the exchange across both axes."""
+    shape, axes = mesh_spec
+    mesh = make_mesh(shape) if axes is None \
+        else make_mesh(shape=shape, axes=axes)
+    axis = "nodes" if axes is None else axes
     sc = _gossip_sparse(64)
-    mesh = make_mesh(8)
-    sharded = ShardedEngine(sc, LINK, mesh, window=W)
+    sharded = ShardedEngine(sc, LINK, mesh, axis=axis, window=W)
     _, strace = sharded.run(400)
     otrace = SuperstepOracle(sc, LINK, window=W).run(400)
     assert_traces_equal(otrace, strace)
