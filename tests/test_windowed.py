@@ -162,6 +162,20 @@ def test_route_cap_exact_when_under_and_counted_when_over():
     assert int(st.delivered) < otrace.total_delivered()
 
 
+def test_sharded_route_cap_with_dropfree_link_stays_exact():
+    """Regression: the single-chip lazy-sampling fast path (route_cap +
+    drop-free link) must NOT engage on the sharded engine (MeshComm
+    subclasses LocalComm — a naive isinstance guard would skip the
+    all_to_all exchange and misroute every cross-shard message)."""
+    sc = _gossip_sparse(64)
+    mesh = make_mesh(8)
+    sharded = ShardedEngine(sc, LINK, mesh, window=W, route_cap=256)
+    st, strace = sharded.run(400)
+    otrace = SuperstepOracle(sc, LINK, window=W).run(400)
+    assert_traces_equal(otrace, strace)
+    assert int(st.route_drop) == 0
+
+
 @pytest.mark.parametrize("mesh_spec", [
     pytest.param((8, None), id="1axis-8dev"),
     pytest.param(((2, 4), ("dcn", "ici")), id="2axis-dcn-ici"),

@@ -352,71 +352,136 @@ class JaxEngine:
         dst_f = out.dst.reshape(S).astype(jnp.int32)
         pay_cols = tuple(out.payload[:, p, :].reshape(S) for p in range(P))
         v_f = out_valid.reshape(S)
-        mbits = msg_bits(self.s0, self.s1, src_f, dst_f, tmsg, slot_f) \
-            if self.link.needs_key else None
-        delay, drop = self.link.sample(src_f, dst_f, tmsg, mbits)
         dst_ok = (dst_f >= 0) & (dst_f < n_glob)
-        ok = v_f & ~drop & dst_ok
         # contract #6 corollary: a scenario emitting an out-of-range
         # destination is a bug — surfaced, never silently dropped
         bad_dst_step = comm.all_sum(
             jnp.sum(v_f & ~dst_ok, dtype=jnp.int32))
-        flight = jnp.maximum(delay, jnp.int64(1))  # contract #4
         # in-window send offset: deliver-times stay epoch(t)-relative
         woff = (tmsg - t).astype(jnp.int32)                     # [0, W)
-        drel64 = woff.astype(jnp.int64) + flight
-        bad_delay_step = comm.all_sum(jnp.sum(
-            ok & (drel64 > jnp.int64(_I32MAX - 1)), dtype=jnp.int32))
-        # windowed-causality violation: a delay shorter than the window
-        # means this message should have been visible to a node that
-        # already fired in this very window — counted, never silent
-        short_step = comm.all_sum(jnp.sum(
-            ok & (flight < W), dtype=jnp.int32)) \
-            if W > 1 else jnp.int32(0)
-        drel = jnp.minimum(drel64, jnp.int64(_I32MAX - 1)).astype(jnp.int32)
         # global sender-major rank — contract #3's arrival order as a
         # sortable value (init guards n_glob * M < 2^31)
         smrank = src_f * jnp.int32(M) + slot_f
 
-        # 6.5. hand each message to the device that owns its destination
-        # (identity single-chip; bucket + all_to_all sharded) — rows come
-        # back device-local
-        ok_r, drel_r, src_r, row_r, smrank_r, woff_r, pay_r, bucket_ovf = \
-            self._exchange(ok, drel, src_f, dst_f, smrank, woff, pay_cols)
+        # Lazy link sampling: when the link cannot drop (validity then
+        # never depends on the sample) and a route_cap is set, sort
+        # FIRST and sample only the sliced prefix — sampling cost and
+        # one sort operand scale with active messages, not outbox
+        # slots. Single-chip only (the sharded exchange ships sampled
+        # deliver-times between devices). With route_drop > 0 the SENT
+        # digest covers only the sliced prefix — already outside the
+        # parity regime by definition.
+        # type check, NOT isinstance: MeshComm subclasses LocalComm, and
+        # the lazy path must never run sharded — it skips _exchange, so
+        # global destinations would be read as local mailbox rows
+        lazy = (self.route_cap is not None
+                and not self.link.can_drop
+                and type(comm) is LocalComm)
 
-        # 7. insert: ONE variadic sort by (destination, send instant,
-        #    sender-major rank) — chronological routing order, contract
-        #    #3 (for W == 1 all offsets are 0 and the key is elided);
-        #    values ride along, replacing the argsort + gather chain
-        #    (gathers cost ~1 ms/131k on TPU; sort is ~free)
-        # sort operands are pruned to the minimum: validity is derived
-        # from the destination sentinel (sd < n ⇔ ok) and the sender
-        # from the rank key (src = smrank // M) — every dropped operand
-        # is S elements of sort traffic saved
-        sort_dst = jnp.where(ok_r, row_r, n)  # invalid -> sentinel row n
-        if W > 1:
-            ops3 = jax.lax.sort(
-                (sort_dst, woff_r, smrank_r, drel_r) + pay_r,
-                dimension=0, num_keys=3)
-            ops3 = ops3[:1] + ops3[2:]  # drop woff; layout as below
+        def slice_cap(ops, ok_mask):
+            """route_cap: valid messages sort to the front (sentinel
+            row n is the largest key), so ranking + scattering only a
+            static prefix is exact while the active count fits; the
+            excess is counted."""
+            drop_step = jnp.int32(0)
+            A = self.route_cap
+            if A is not None and A < ops[0].shape[0]:
+                total_ok = jnp.sum(ok_mask, dtype=jnp.int32)
+                ops = tuple(o[:A] for o in ops)
+                drop_step = total_ok - jnp.sum(
+                    ops[0] < n, dtype=jnp.int32)
+            return ops, comm.all_sum(drop_step)
+
+        if lazy:
+            ok = v_f & dst_ok
+            sort_dst = jnp.where(ok, dst_f, n)
+            if W > 1:
+                opsL = jax.lax.sort(
+                    (sort_dst, woff, smrank) + pay_cols,
+                    dimension=0, num_keys=3)
+            else:
+                opsL = jax.lax.sort(
+                    (sort_dst, smrank) + pay_cols, dimension=0,
+                    num_keys=2)
+            opsL, route_drop_step = slice_cap(opsL, ok)
+            if W > 1:
+                sd, woff_s, smrank_s = opsL[0], opsL[1], opsL[2]
+                pay_s = opsL[3:]
+            else:
+                sd, smrank_s = opsL[0], opsL[1]
+                woff_s = jnp.zeros_like(sd)
+                pay_s = opsL[2:]
+            ok_s = sd < n
+            src_s = smrank_s // jnp.int32(M)
+            slot_s = smrank_s % jnp.int32(M)
+            tmsg_s = t + woff_s.astype(jnp.int64)
+            # sample the survivors; invalid lanes (sd == n) are fed the
+            # sentinel and masked — `sample` is elementwise by contract
+            mbits_s = msg_bits(self.s0, self.s1, src_s, sd, tmsg_s,
+                               slot_s) if self.link.needs_key else None
+            delay_s, _ = self.link.sample(src_s, sd, tmsg_s, mbits_s)
+            flight_s = jnp.maximum(delay_s, jnp.int64(1))  # contract #4
+            drel64_s = woff_s.astype(jnp.int64) + flight_s
+            bad_delay_step = comm.all_sum(jnp.sum(
+                ok_s & (drel64_s > jnp.int64(_I32MAX - 1)),
+                dtype=jnp.int32))
+            short_step = comm.all_sum(jnp.sum(
+                ok_s & (flight_s < W), dtype=jnp.int32)) \
+                if W > 1 else jnp.int32(0)
+            drel_s = jnp.minimum(drel64_s,
+                                 jnp.int64(_I32MAX - 1)).astype(jnp.int32)
+            sent_count_msgs = ok  # full validity mask (counts all sent)
+            bucket_ovf = jnp.int32(0)
         else:
-            ops3 = jax.lax.sort(
-                (sort_dst, smrank_r, drel_r) + pay_r,
-                dimension=0, num_keys=2)
-        # route_cap: valid messages sort to the front (sentinel row n is
-        # the largest key), so ranking + scattering only a static prefix
-        # is exact while the active count fits; the excess is counted
-        route_drop_step = jnp.int32(0)
-        A = self.route_cap
-        if A is not None and A < ops3[0].shape[0]:
-            total_ok = jnp.sum(ok_r, dtype=jnp.int32)
-            ops3 = tuple(o[:A] for o in ops3)
-            route_drop_step = total_ok - jnp.sum(
-                ops3[0] < n, dtype=jnp.int32)
-        route_drop_step = comm.all_sum(route_drop_step)
-        sd, drel_s = ops3[0], ops3[2]
-        ok_s = sd < n
-        src_s = ops3[1] // jnp.int32(M)   # smrank = src * M + slot
+            mbits = msg_bits(self.s0, self.s1, src_f, dst_f, tmsg,
+                             slot_f) if self.link.needs_key else None
+            delay, drop = self.link.sample(src_f, dst_f, tmsg, mbits)
+            ok = v_f & ~drop & dst_ok
+            flight = jnp.maximum(delay, jnp.int64(1))  # contract #4
+            drel64 = woff.astype(jnp.int64) + flight
+            bad_delay_step = comm.all_sum(jnp.sum(
+                ok & (drel64 > jnp.int64(_I32MAX - 1)), dtype=jnp.int32))
+            # windowed-causality violation: a delay shorter than the
+            # window means this message should have been visible to a
+            # node that already fired in this very window — counted,
+            # never silent
+            short_step = comm.all_sum(jnp.sum(
+                ok & (flight < W), dtype=jnp.int32)) \
+                if W > 1 else jnp.int32(0)
+            drel = jnp.minimum(drel64,
+                               jnp.int64(_I32MAX - 1)).astype(jnp.int32)
+
+            # 6.5. hand each message to the device that owns its
+            # destination (identity single-chip; bucket + all_to_all
+            # sharded) — rows come back device-local
+            (ok_r, drel_r, src_r, row_r, smrank_r, woff_r, pay_r,
+             bucket_ovf) = self._exchange(
+                ok, drel, src_f, dst_f, smrank, woff, pay_cols)
+
+            # 7. insert: ONE variadic sort by (destination, send
+            #    instant, sender-major rank) — chronological routing
+            #    order, contract #3 (for W == 1 all offsets are 0 and
+            #    the key is elided); values ride along, replacing the
+            #    argsort + gather chain. Sort operands are pruned to
+            #    the minimum: validity is derived from the destination
+            #    sentinel (sd < n ⇔ ok) and the sender from the rank
+            #    key (src = smrank // M).
+            sort_dst = jnp.where(ok_r, row_r, n)  # invalid -> row n
+            if W > 1:
+                ops3 = jax.lax.sort(
+                    (sort_dst, woff_r, smrank_r, drel_r) + pay_r,
+                    dimension=0, num_keys=3)
+                ops3 = ops3[:1] + ops3[2:]  # drop woff; layout as below
+            else:
+                ops3 = jax.lax.sort(
+                    (sort_dst, smrank_r, drel_r) + pay_r,
+                    dimension=0, num_keys=2)
+            ops3, route_drop_step = slice_cap(ops3, ok_r)
+            sd, drel_s = ops3[0], ops3[2]
+            ok_s = sd < n
+            src_s = ops3[1] // jnp.int32(M)   # smrank = src * M + slot
+            pay_s = ops3[3:]
+            sent_count_msgs = ok
         rank = group_rank(sd)
         if sc.commutative_inbox:
             # r-th incoming message takes the destination's r-th hole
@@ -438,7 +503,7 @@ class JaxEngine:
             mb_src = mb_src.at[col, row].set(src_s, mode="drop")
         for p in range(P):
             mb_payload = mb_payload.at[col, p, row].set(
-                ops3[3 + p], mode="drop")
+                pay_s[p], mode="drop")
         overflow_step = comm.all_sum(
             jnp.sum(ok_s & (pos >= K), dtype=jnp.int32)) + bucket_ovf
 
@@ -473,11 +538,21 @@ class JaxEngine:
             _tlo(d_abs), _thi(d_abs),
             st.mb_payload[:, 0, :])
         recv_hash = comm.all_sum(_u32sum(jnp.where(deliver, recv_mix, 0)))
-        dt_abs = t + drel64  # == send instant + flight time
-        sent_mix = mix32_jnp(SENT, src_f, dst_f, _tlo(dt_abs), _thi(dt_abs),
-                             pay_cols[0])
-        sent_hash = comm.all_sum(_u32sum(jnp.where(ok, sent_mix, 0)))
-        sent_count = comm.all_sum(jnp.sum(ok, dtype=jnp.int32))
+        if lazy:
+            # delays exist only for the sorted/sliced survivors; with
+            # route_drop == 0 (the parity regime) this is every sent
+            # message
+            dt_abs = tmsg_s + flight_s  # == send instant + flight time
+            sent_mix = mix32_jnp(SENT, src_s, sd, _tlo(dt_abs),
+                                 _thi(dt_abs), pay_s[0])
+            sent_hash = comm.all_sum(_u32sum(jnp.where(ok_s, sent_mix, 0)))
+        else:
+            dt_abs = t + drel64  # == send instant + flight time
+            sent_mix = mix32_jnp(SENT, src_f, dst_f, _tlo(dt_abs),
+                                 _thi(dt_abs), pay_cols[0])
+            sent_hash = comm.all_sum(_u32sum(jnp.where(ok, sent_mix, 0)))
+        sent_count = comm.all_sum(jnp.sum(sent_count_msgs,
+                                          dtype=jnp.int32))
 
         yrow = _StepOut(
             valid=live, t=t,

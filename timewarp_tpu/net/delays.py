@@ -60,6 +60,15 @@ class LinkModel:
         Conservative default: 1 µs (no windowing headroom)."""
         return 1
 
+    @property
+    def can_drop(self) -> bool:
+        """Whether ``sample`` can ever return ``drop=True``. Drop-free
+        models let the general engine defer link sampling until after
+        the routing sort + route_cap slice (sampling cost ∝ active
+        messages, not outbox slots — engine.py lazy-sampling path).
+        Conservative default: True."""
+        return True
+
 
 @dataclass(frozen=True)
 class FixedDelay(LinkModel):
@@ -74,6 +83,10 @@ class FixedDelay(LinkModel):
     @property
     def min_delay_us(self) -> int:
         return max(int(self.delay), 1)
+
+    @property
+    def can_drop(self) -> bool:
+        return False
 
 
 @dataclass(frozen=True)
@@ -92,6 +105,10 @@ class UniformDelay(LinkModel):
     @property
     def min_delay_us(self) -> int:
         return max(int(self.lo), 1)
+
+    @property
+    def can_drop(self) -> bool:
+        return False
 
 
 @dataclass(frozen=True)
@@ -125,6 +142,10 @@ class LogNormalDelay(LinkModel):
     @property
     def min_delay_us(self) -> int:
         return max(int(self.floor_us), 1)
+
+    @property
+    def can_drop(self) -> bool:
+        return False
 
 
 @dataclass(frozen=True)
@@ -179,6 +200,10 @@ class Quantize(LinkModel):
         q = int(self.quantum_us)
         m = self.inner.min_delay_us
         return ((m + q - 1) // q) * q
+
+    @property
+    def can_drop(self) -> bool:
+        return self.inner.can_drop
 
 
 @dataclass(frozen=True)
