@@ -1,0 +1,33 @@
+"""API_MAP.md is the migration contract for users of the reference —
+every ``tw.<name>`` it promises must actually exist on the package, and
+the table must not silently rot as the API evolves. (The reference had
+exactly this failure mode: its token-ring example imports an API that
+no longer existed — SURVEY.md "critical historical note".)"""
+
+import re
+import os
+
+import timewarp_tpu as tw
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_every_promised_name_exists():
+    text = open(os.path.join(ROOT, "API_MAP.md")).read()
+    names = sorted(set(re.findall(r"`tw\.([A-Za-z_][A-Za-z_0-9]*)", text)))
+    assert len(names) > 25, "API_MAP stopped mentioning tw.* names?"
+    missing = [n for n in names if not hasattr(tw, n)]
+    assert not missing, f"API_MAP promises absent names: {missing}"
+
+
+def test_core_surface_importable():
+    """The names a migrating user reaches for first, explicitly."""
+    for name in ("Wait", "Fork", "ForkSlave", "GetTime", "MyTid",
+                 "ThrowTo", "fork", "fork_", "fork_slave", "timeout",
+                 "schedule", "invoke", "work", "kill_thread",
+                 "start_timer", "sleep_forever", "repeat_forever",
+                 "run_emulation", "run_real_time", "JobCurator",
+                 "Plain", "Force", "WithTimeout", "for_", "after",
+                 "till", "at", "now", "mcs", "ms", "sec", "minute",
+                 "hour", "FOREVER"):
+        assert hasattr(tw, name), name
