@@ -10,7 +10,7 @@ from __future__ import annotations
 
 import logging
 import random
-from typing import List, Optional, Sequence
+from typing import Optional, Sequence
 
 from ..core.effects import Program, Wait, fork_, start_timer
 from ..manage.sync import Flag

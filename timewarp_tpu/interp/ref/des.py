@@ -31,7 +31,7 @@ import logging
 from dataclasses import dataclass
 from typing import Any, Dict, List, Optional
 
-from ...core.effects import (AwaitIO, Effect, Fork, ForkSlave, GetLogName,
+from ...core.effects import (AwaitIO, Fork, ForkSlave, GetLogName,
                              GetTime, MyTid, Park, Program, ProgramFn,
                              SetLogName, ThrowTo, Unpark, Wait)
 from ...core.errors import DeadlockError, ThreadKilled, TimedError

@@ -14,7 +14,6 @@ The delays spec reproduces Main.hs:73-77: observer-bound messages are
 
 from __future__ import annotations
 
-from dataclasses import dataclass
 from typing import Any, List, Optional, Tuple
 
 import jax.numpy as jnp
