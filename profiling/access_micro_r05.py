@@ -1,0 +1,92 @@
+"""Random-access primitive costs on this chip (round 5).
+
+The sparse-superstep design space is bounded by three numbers: long
+1-op sort, short-axis sort, and random gather/scatter in its several
+forms. Measure them all inside fori_loops with readback sync.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from timewarp_tpu.utils import jaxconfig  # noqa: F401
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+N = 1 << 20
+A = 1 << 17
+K = 16
+REPS = 32
+
+
+def loop(name, fn, *args):
+    def rep(x, *rest):
+        def body(i, x):
+            return fn(x, i, *rest)
+        return lax.fori_loop(jnp.int32(0), jnp.int32(REPS), body, x)
+    f = jax.jit(rep)
+    out = f(*args)
+    int(jnp.asarray(jax.tree.leaves(out)[0]).reshape(-1)[0])
+    t0 = time.perf_counter()
+    out = f(*args)
+    int(jnp.asarray(jax.tree.leaves(out)[0]).reshape(-1)[0])
+    dt = (time.perf_counter() - t0) / REPS
+    print(json.dumps({"op": name, "ms": round(dt * 1e3, 3)}))
+
+
+def main():
+    key = jax.random.PRNGKey(0)
+    idx = jax.random.randint(key, (A,), 0, N, dtype=jnp.int32)
+    x1 = jnp.arange(N, dtype=jnp.int32)
+    x2 = jnp.tile(x1[None, :], (K, 1))          # [K, N]
+    xa = jnp.arange(A, dtype=jnp.int32)
+
+    # sorts
+    loop("sort 1M 1-op", lambda x, i: lax.sort(x ^ i), x1)
+    loop("sort [1024,1024] minor-axis 1-op",
+         lambda x, i: lax.sort((x ^ i).reshape(1024, 1024),
+                               dimension=1).reshape(N), x1)
+    loop("sort [16,N] short-axis", lambda x, i: lax.sort(x ^ i,
+                                                         dimension=0), x2)
+    loop("sort 131k 1-op", lambda x, i: lax.sort(x ^ i), idx)
+    loop("sort 131k 4-op 3-key",
+         lambda x, i: lax.sort((x ^ i, x, x, x), dimension=0,
+                               num_keys=3)[0], idx)
+    loop("sort 1M 3-op 3-key",
+         lambda x, i: lax.sort((x ^ i, x, x), dimension=0,
+                               num_keys=3)[0], x1)
+
+    # gathers
+    loop("gather 1D 131k from 1M",
+         lambda x, i: x.at[:A].set(x[(idx ^ i) % N]), x1)
+    loop("gather 1D 131k sorted idx",
+         lambda x, i: x.at[:A].set(x[jnp.clip(xa * 8 + i, 0, N - 1)]), x1)
+    loop("take [16,N] axis1 131k (minor gather)",
+         lambda x, i: x.at[:, :A].set(jnp.take(x, (idx ^ i) % N, axis=1)),
+         x2)
+    loop("per-row 16x 1D gather 131k",
+         lambda x, i: x.at[0, :A].set(
+             sum(x[k][(idx ^ i) % N] for k in range(K))), x2)
+
+    # scatters
+    loop("scatter 1D 131k into 1M",
+         lambda x, i: x.at[(idx ^ i) % N].set(i, mode="drop"), x1)
+    loop("scatter 2D [col,row] 131k into [16,N]",
+         lambda x, i: x.at[(idx ^ i) % K, (idx ^ (i * 7)) % N].set(
+             i, mode="drop"), x2)
+    loop("scatter [16,A] cols into [16,N] (minor)",
+         lambda x, i: x.at[:, (idx ^ i) % N].set(i, mode="drop"), x2)
+
+    # elementwise reference
+    loop("elementwise [16,N] 3 passes",
+         lambda x, i: jnp.where(x > i, x - 1, x + 1) ^ (x >> 1), x2)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,109 @@
+"""Round-5 iteration harness: in-loop superstep cost for the two
+sparse laggard configs (gossip_100k wave, praos_1m), synced by host
+readback (NOT block_until_ready — not a true sync on this tunnel
+backend, PERF_r04.md). Run repeatedly while optimizing the lazy
+insertion path; trust deltas within one session (calib printed first).
+
+Usage: python profiling/iter_r05.py [wave|praos|steady] [steps]
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from timewarp_tpu.utils import jaxconfig  # noqa: F401
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def calib():
+    @jax.jit
+    def kern(x):
+        def body(i, x):
+            return lax.sort(x * jnp.int32(1103515245) + i)
+        return lax.fori_loop(jnp.int32(0), jnp.int32(64), body, x)
+    x = jnp.arange(1 << 20, dtype=jnp.int32)
+    int(kern(x)[0])
+    t0 = time.perf_counter()
+    int(kern(x)[0])
+    print(json.dumps({"calib_s": round(time.perf_counter() - t0, 4)}))
+
+
+def wave_engine(n=100_000):
+    from timewarp_tpu.interp.jax_engine.engine import JaxEngine
+    from timewarp_tpu.models.gossip import gossip, gossip_links
+    from timewarp_tpu.net.delays import Quantize
+    sc = gossip(n, fanout=8, think_us=2_000, burst=True,
+                end_us=5_000_000, mailbox_cap=16)
+    link = Quantize(gossip_links(median_us=20_000, sigma=0.6,
+                                 floor_us=8_000), 1_000)
+    cap = None
+    if os.environ.get("TW_LEGACY_CAP"):
+        cap = min(1 << 17, n * 8)
+    return JaxEngine(sc, link, window=8_000, route_cap=cap)
+
+
+def praos_engine(n=1 << 20):
+    from timewarp_tpu.interp.jax_engine.engine import JaxEngine
+    from timewarp_tpu.models.praos import praos
+    from timewarp_tpu.net.delays import LogNormalDelay, Quantize
+    sc = praos(n, slot_us=1_000_000, n_slots=1 << 30,
+               leader_prob=4.0 / n, fanout=8, burst=True,
+               mailbox_cap=16)
+    link = Quantize(LogNormalDelay(20_000, 0.6, cap_us=150_000,
+                                   floor_us=8_000), 1_000)
+    cap = None
+    if os.environ.get("TW_LEGACY_CAP"):
+        cap = min(3 << 19, n * 8)
+    return JaxEngine(sc, link, window=8_000, route_cap=cap)
+
+
+def steady_engine(n=1 << 20):
+    from timewarp_tpu.interp.jax_engine.engine import JaxEngine
+    from timewarp_tpu.models.gossip import gossip
+    from timewarp_tpu.net.delays import Quantize, UniformDelay
+    sc = gossip(n, fanout=1, think_us=1_000, gossip_interval=1_000,
+                end_us=(1 << 50), steady=True, mailbox_cap=8)
+    link = Quantize(UniformDelay(500, 4_500), 1_000)
+    return JaxEngine(sc, link)
+
+
+def main():
+    which = sys.argv[1] if len(sys.argv) > 1 else "wave"
+    steps = int(sys.argv[2]) if len(sys.argv) > 2 else 0
+    calib()
+    eng = {"wave": wave_engine, "praos": praos_engine,
+           "steady": steady_engine}[which]()
+    warm = {"wave": 8, "praos": 16, "steady": 64}[which]
+    msteps = steps or {"wave": 60, "praos": 64, "steady": 64}[which]
+    st = eng.init_state()
+    t0 = time.perf_counter()
+    st = eng.run_quiet(warm, st)
+    int(st.delivered)
+    print(json.dumps({"compile_plus_warm_s":
+                      round(time.perf_counter() - t0, 2)}))
+    t0 = time.perf_counter()
+    fin = eng.run_quiet(msteps, st)
+    delivered = int(fin.delivered) - int(st.delivered)
+    dt = time.perf_counter() - t0
+    nsteps = int(fin.steps) - int(st.steps)
+    print(json.dumps({
+        "config": which,
+        "steps": nsteps,
+        "ms_per_superstep": round(dt * 1e3 / max(nsteps, 1), 3),
+        "delivered": delivered,
+        "msg_per_s": round(delivered / dt, 1),
+        "route_drop": int(fin.route_drop),
+        "short_delay": int(fin.short_delay),
+        "overflow": int(fin.overflow),
+    }))
+
+
+if __name__ == "__main__":
+    main()

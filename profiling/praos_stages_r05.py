@@ -1,0 +1,161 @@
+"""Stage isolation for the praos superstep at 2^20 nodes (round 5).
+
+Each stage is jitted alone inside a 32-iteration fori_loop with
+host-readback sync; numbers carry the dispatch/loop floor, so read
+deltas. Run after iter_r05.py showed the adaptive routing landed at
+~30 ms/superstep — the question is where the [K,N] base goes.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from timewarp_tpu.utils import jaxconfig  # noqa: F401
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from iter_r05 import praos_engine, calib
+
+REPS = 32
+
+
+def timeit(name, fn, *args):
+    f = jax.jit(fn)
+    out = f(*args)
+    leaf = jax.tree.leaves(out)[0]
+    int(jnp.asarray(leaf).reshape(-1)[0])  # readback sync
+    t0 = time.perf_counter()
+    out = f(*args)
+    int(jnp.asarray(jax.tree.leaves(out)[0]).reshape(-1)[0])
+    dt1 = time.perf_counter() - t0
+    print(json.dumps({"stage": name, "ms": round(dt1 * 1e3, 2)}))
+
+
+def loop(name, fn, *args):
+    """fn must map its first arg to same-shape output; 32 iterations."""
+    def rep(x, *rest):
+        def body(i, x):
+            return fn(x, *rest)
+        return lax.fori_loop(0, REPS, body, x)
+    f = jax.jit(rep)
+    out = f(*args)
+    int(jnp.asarray(jax.tree.leaves(out)[0]).reshape(-1)[0])
+    t0 = time.perf_counter()
+    out = f(*args)
+    int(jnp.asarray(jax.tree.leaves(out)[0]).reshape(-1)[0])
+    dt = (time.perf_counter() - t0) / REPS
+    print(json.dumps({"stage": name, "ms_per_iter": round(dt * 1e3, 3)}))
+
+
+def main():
+    calib()
+    eng = praos_engine()
+    sc = eng.scenario
+    st = eng.init_state()
+    st = eng.run_quiet(24, st)
+    int(st.delivered)
+    K, M, P = sc.mailbox_cap, sc.max_out, sc.payload_width
+    n = sc.n_nodes
+    print(json.dumps({"n": n, "K": K, "M": M, "P": P}))
+
+    I32MAX = jnp.int32(2**31 - 1)
+    NEVER = jnp.int64((1 << 62))
+
+    # A: next-event reduction
+    def next_ev(mb_rel, wake, t):
+        nnr = mb_rel.min(axis=0)
+        node_next = jnp.minimum(
+            wake, jnp.where(nnr == I32MAX, NEVER,
+                            t + nnr.astype(jnp.int64)))
+        return mb_rel + (node_next.min() % 7).astype(jnp.int32)
+    loop("A next-event [K,N]+[N]", lambda x: next_ev(x, st.wake, st.time),
+         st.mb_rel)
+
+    # B: deliver mask + commutative inbox wheres ([K,N] + [K,P,N])
+    def inbox(mb_rel, mb_pay, wake, t):
+        live = mb_rel < I32MAX
+        nnr = mb_rel.min(axis=0)
+        node_next = jnp.minimum(
+            wake, jnp.where(nnr == I32MAX, NEVER,
+                            t + nnr.astype(jnp.int64)))
+        tmin = node_next.min()
+        fire = (node_next < NEVER) & (node_next - tmin < 8000)
+        nrel = jnp.minimum(node_next - t, jnp.int64(2**31 - 2)
+                           ).astype(jnp.int32)
+        deliver = live & (mb_rel <= nrel[None, :]) & fire[None, :]
+        itime = jnp.where(deliver, t + mb_rel.astype(jnp.int64), NEVER)
+        ipay = jnp.where(deliver[:, None, :], mb_pay, 0)
+        return (ipay + itime[:, None, :].astype(jnp.int32)) % 5
+    loop("B deliver+inbox wheres", lambda x: inbox(st.mb_rel, x, st.wake,
+                                                   st.time),
+         st.mb_payload)
+
+    # C: free-rows single-operand sort [K,N]
+    slots = jnp.broadcast_to(jnp.arange(K, dtype=jnp.int32)[:, None],
+                             (K, n))
+    def freerows(mb_rel):
+        keep = mb_rel < I32MAX
+        return lax.sort(jnp.where(keep, jnp.int32(K), slots), dimension=0)
+    loop("C free-rows sort [K,N]", lambda x: freerows(x) % 3 + x % 2,
+         st.mb_rel)
+
+    # D: sender compaction sort [N] single operand
+    ids = jnp.arange(n, dtype=jnp.int32)
+    def sender_sort(x):
+        livemask = (x[0] % 97) < 3   # ~3% active
+        return lax.sort(jnp.where(livemask, ids, jnp.int32(n)))[None, :]
+    loop("D sender sort [N] 1-op", lambda x: sender_sort(x) % 5 + x % 2,
+         st.mb_rel)
+
+    # E: the vmap'd step function alone (praos leader check + adopt)
+    from timewarp_tpu.core.rng import fire_bits
+    from timewarp_tpu.core.scenario import Inbox
+    node_ids = jnp.arange(n, dtype=jnp.int32)
+    def stepfn(mb_rel, mb_pay, states):
+        deliver = mb_rel < I32MAX
+        ib = Inbox(valid=deliver,
+                   src=jnp.zeros((K, n), jnp.int32),
+                   time=jnp.where(deliver,
+                                  st.time + mb_rel.astype(jnp.int64),
+                                  NEVER),
+                   payload=jnp.where(deliver[:, None, :], mb_pay, 0))
+        now_vec = jnp.full((n,), st.time + 1000)
+        bits = fire_bits(eng.s0, eng.s1, node_ids, now_vec)
+        from timewarp_tpu.core.scenario import Outbox
+        ns, out, nw = jax.vmap(
+            sc.step,
+            in_axes=(0, Inbox(valid=-1, src=-1, time=-1, payload=-1),
+                     0, 0, 0),
+            out_axes=(0, Outbox(valid=-1, dst=-1, payload=-1), 0))(
+                states, ib, now_vec, node_ids, bits)
+        return mb_rel % 3 + \
+            jax.tree.leaves(ns)[0][None, :n].astype(jnp.int32)
+    loop("E inbox+step vmap", lambda x: stepfn(x, st.mb_payload,
+                                               st.states) % 7 + x % 2,
+         st.mb_rel)
+
+    # F: full superstep for reference
+    step = lambda s: eng._superstep(s, False)[0]
+    def full(s):
+        def body(i, s):
+            return step(s)
+        return lax.fori_loop(0, REPS, body, s)
+    f = jax.jit(full)
+    out = f(st)
+    int(out.delivered)
+    t0 = time.perf_counter()
+    out = f(st)
+    int(out.delivered)
+    dt = (time.perf_counter() - t0) / REPS
+    print(json.dumps({"stage": "F FULL superstep",
+                      "ms_per_iter": round(dt * 1e3, 3)}))
+
+
+if __name__ == "__main__":
+    main()

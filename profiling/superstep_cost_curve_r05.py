@@ -1,0 +1,87 @@
+"""Cost of ONE praos/wave superstep as a function of its load.
+
+Deterministic sim => any superstep is reproducible: run k steps from
+init, then measure that single superstep by repeating it REPS times in
+a fori_loop (the carry perturbs only the `steps` counter, which feeds
+nothing downstream, so XLA cannot hoist the loop body). RTT-corrected
+by the loop length.
+
+Usage: python profiling/superstep_cost_curve_r05.py [praos|wave]
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from timewarp_tpu.utils import jaxconfig  # noqa: F401
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from iter_r05 import praos_engine, wave_engine
+
+REPS = 64
+
+
+def one_superstep_cost(eng, st):
+    def rep(s0):
+        def body(i, carry):
+            s = s0._replace(steps=s0.steps + i)   # defeats hoisting
+            out = eng._superstep(s, False)[0]
+            # thread data-dependence bits through the routing and
+            # insertion outputs so XLA cannot DCE them
+            dep = (out.mb_rel[0, 0].astype(jnp.int64) & 1) ^ \
+                (out.mb_payload[0, 0, 0].astype(jnp.int64) & 1) ^ \
+                (out.wake[0] & 1)
+            return carry._replace(
+                delivered=out.delivered + dep, time=out.time,
+                overflow=out.overflow)
+        return lax.fori_loop(jnp.int32(0), jnp.int32(REPS), body, s0)
+    f = jax.jit(rep)
+    out = f(st)
+    int(out.delivered)
+    best = 1e9
+    for _ in range(2):
+        t0 = time.perf_counter()
+        out = f(st)
+        int(out.delivered)
+        best = min(best, (time.perf_counter() - t0) / REPS)
+    return best * 1e3
+
+
+def main():
+    which = sys.argv[1] if len(sys.argv) > 1 else "praos"
+    eng = {"praos": praos_engine, "wave": wave_engine}[which]()
+    warm = {"praos": 24, "wave": 8}[which]
+    st = eng.init_state()
+    st = eng.run_quiet(warm, st)
+    int(st.delivered)
+    fin, tr = eng.run(128, st)
+    sent = np.asarray(tr.sent_count)
+    fired = np.asarray(tr.fired_count)
+    # pick superstep indices spanning the load range
+    order = np.argsort(sent)
+    picks = sorted(set(
+        int(order[int(q * (len(order) - 1))])
+        for q in (0.0, 0.5, 0.75, 0.9, 0.97, 1.0)))
+    print(json.dumps({"n_steps": len(sent),
+                      "sent_p50": int(np.percentile(sent, 50)),
+                      "sent_max": int(sent.max())}))
+    for j in picks:
+        stj = eng.run_quiet(j, st) if j else st
+        int(stj.delivered)
+        ms = one_superstep_cost(eng, stj)
+        print(json.dumps({
+            "step": j, "sent": int(sent[j]), "fired": int(fired[j]),
+            "ms": round(ms, 3)}))
+
+
+if __name__ == "__main__":
+    main()

@@ -24,6 +24,12 @@ import sys
 __all__ = ["main"]
 
 
+def _window_arg(s: str):
+    """--window accepts a µs integer or "auto" (derive the widest
+    exact window from the link model's declared minimum delay)."""
+    return "auto" if s == "auto" else int(s)
+
+
 def parse_link(spec: str):
     """``fixed:D`` | ``uniform:LO:HI`` | ``lognormal:MEDIAN:SIGMA`` —
     optionally wrapped ``drop:P:<inner>`` and/or ``quantize:Q:<inner>``."""
@@ -134,9 +140,11 @@ def main(argv=None) -> int:
     p.add_argument("--burst", action="store_true",
                    help="gossip/praos: flood all fanout peers in one "
                         "firing (the windowed-superstep-friendly form)")
-    p.add_argument("--window", type=int, default=1,
-                   help="multi-instant superstep window in µs "
-                        "(requires link min delay >= window)")
+    p.add_argument("--window", type=_window_arg, default=1,
+                   help="multi-instant superstep window in µs, or "
+                        "'auto' to use the link model's declared "
+                        "minimum delay (requires link min delay >= "
+                        "window)")
     p.add_argument("--route-cap", type=int, default=None,
                    help="static active-message budget for the insertion "
                         "stage (clipped messages are counted)")

@@ -135,14 +135,39 @@ class JaxEngine:
       dropped and counted in ``EngineState.route_drop`` (an engine
       capacity limit the oracle does not model — a parity run must
       keep the counter 0, like ``short_delay``).
+
+    Adaptive sender-compacted routing (round 5, the default sparse
+    path): when ``route_cap`` is None, the link cannot drop, the engine
+    is single-chip, and the workload is windowed or wide-outbox
+    (``window > 1 or max_out > 1``), routing never touches the
+    S = N·max_out flattened arrays. All ``max_out`` lanes of a sender
+    share ``(src, send instant)``, so the engine compacts *senders*
+    (one single-operand sort of N node ids — the only N-sized routing
+    cost), then gathers outbox lanes, sorts by ``(dst, window offset,
+    sender-major rank)``, samples link delays, ranks and scatters at a
+    **ladder-selected static width**: a `lax.switch` over geometric
+    sender-count rungs (…, n/16, n/4, n) picks the smallest compiled
+    variant that fits this superstep's device-computed active-sender
+    count, so insertion cost tracks instantaneous load instead of the
+    workload's peak. The top rung is always n — no message can ever be
+    dropped (``route_drop`` stays 0 by construction), so no capacity
+    knob needs hand-tuning. Event semantics, arrival order (contract
+    #3) and digests are identical to the eager path.
     """
 
     def __init__(self, scenario: Scenario, link: LinkModel, *,
-                 seed: int = 0, window: int = 1,
+                 seed: int = 0, window=1,
                  route_cap: Optional[int] = None) -> None:
         if scenario.n_nodes * scenario.max_out >= 2**31:
             raise ValueError(
                 "n_nodes * max_out must fit int32 (sender-major rank)")
+        if window == "auto":
+            # widest exact window the link model licenses: every delay
+            # is declared >= min_delay_us, so instants within that
+            # span are causally independent (class docstring). A
+            # floor-less link (min 1) degenerates to the classic
+            # engine — correct, just unbatched.
+            window = max(1, int(link.min_delay_us))
         if window < 1:
             raise ValueError(f"window must be >= 1 µs, got {window}")
         if window > 1 and window > link.min_delay_us:
@@ -208,6 +233,184 @@ class JaxEngine:
         offset — insertion sorts on (woff, smrank), so exchange order
         never matters."""
         return ok, drel, src_f, dst_f, smrank, woff, pay_cols, jnp.int32(0)
+
+    @staticmethod
+    def _sender_rungs(n: int):
+        """Geometric x2 ladder of static sender-count widths for the
+        adaptive routing switch: 1024, 2048, …, n. The top rung is
+        always n, so the adaptive path can never drop a message; the
+        x2 spacing bounds gather/scatter overshoot at 2x the active
+        count (the branch cost is linear in the rung)."""
+        rungs = []
+        a = 1024
+        while a < n:
+            rungs.append(a)
+            a *= 2
+        rungs.append(n)
+        return rungs
+
+    def _sample_nodrop(self, src, dst, tmsg, slot, woff, ok):
+        """Shared link-sampling tail for the no-drop routing paths
+        (lazy and adaptive): derive per-message entropy, apply the
+        contract-#4 ``>= 1 µs`` flight clamp, saturate the epoch-
+        relative deliver time to int32, and count the never-silent
+        ``bad_delay`` / ``short_delay`` violations. One implementation
+        so the regimes cannot drift apart bit-wise."""
+        mbits = msg_bits(self.s0, self.s1, src, dst, tmsg, slot) \
+            if self.link.needs_key else None
+        delay, _ = self.link.sample(src, dst, tmsg, mbits)
+        flight = jnp.maximum(delay, jnp.int64(1))       # contract #4
+        drel64 = woff.astype(jnp.int64) + flight
+        bad = jnp.sum(ok & (drel64 > jnp.int64(_I32MAX - 1)),
+                      dtype=jnp.int32)
+        short = jnp.sum(ok & (flight < self.window), dtype=jnp.int32) \
+            if self.window > 1 else jnp.int32(0)
+        drel = jnp.minimum(drel64,
+                           jnp.int64(_I32MAX - 1)).astype(jnp.int32)
+        return flight, drel, bad, short
+
+    def _insert_sorted(self, mb_rel, mb_src, mb_payload, sd, ok_s,
+                       drel_s, src_s, pay_s, free_rows, counts):
+        """Shared mailbox insertion for destination-sorted messages:
+        per-destination rank -> target slot (r-th hole for commutative
+        inboxes, append-after-kept otherwise) -> flat 1D scatters (the
+        2D [col, row] scatter form costs ~7x on this chip,
+        profiling/micro2_r05.py). Non-fitting lanes get an
+        out-of-range flat index and are dropped; returns the updated
+        arrays plus the local overflow count."""
+        sc = self.scenario
+        K, P = sc.mailbox_cap, sc.payload_width
+        n = self.comm.n_local
+        rank = group_rank(sd)
+        if sc.commutative_inbox:
+            # r-th incoming message takes the destination's r-th hole
+            prow = free_rows[jnp.clip(rank, 0, K - 1),
+                             jnp.clip(sd, 0, n - 1)]
+            fits = ok_s & (rank < K) & (prow < K)
+            col = jnp.clip(prow, 0, K - 1)
+            pos = jnp.where(fits, jnp.int32(0), jnp.int32(K))
+        else:
+            pos = counts[jnp.clip(sd, 0, n - 1)] + rank
+            fits = ok_s & (pos < K)
+            col = jnp.clip(pos, 0, K - 1)
+        flat = jnp.where(fits, col * jnp.int32(n) + sd,
+                         jnp.int32(K * n))
+        mb_rel = mb_rel.reshape(-1).at[flat].set(
+            drel_s, mode="drop").reshape(K, n)
+        if sc.inbox_src:
+            # inbox_src=False skips this whole scatter — mailbox
+            # scatters ARE the dense random-delivery cost floor
+            # (PERF_r04.md), so dropping an unread field is ~1/3 of it
+            mb_src = mb_src.reshape(-1).at[flat].set(
+                src_s, mode="drop").reshape(K, n)
+        mb_payload = mb_payload.reshape(-1)
+        for p in range(P):
+            flat_p = jnp.where(
+                fits, (col * jnp.int32(P) + p) * jnp.int32(n) + sd,
+                jnp.int32(K * P * n))
+            mb_payload = mb_payload.at[flat_p].set(pay_s[p],
+                                                   mode="drop")
+        mb_payload = mb_payload.reshape(K, P, n)
+        overflow = jnp.sum(ok_s & (pos >= K), dtype=jnp.int32)
+        return mb_rel, mb_src, mb_payload, overflow
+
+    def _route_adaptive(self, out, out_valid, now_vec, t, mb_rel,
+                        mb_src, mb_payload, free_rows, counts,
+                        node_ids, with_trace):
+        """Sender-compacted adaptive-width routing + insertion (class
+        docstring): compact active sender ids with ONE single-operand
+        N-sort, then gather/sort/sample/rank/scatter at the smallest
+        ladder rung that fits this superstep's active-sender count
+        (``lax.switch`` — every branch is static-shape, so this is
+        XLA-legal). All ``max_out`` lanes of a sender share its firing
+        instant, so per-sender compaction preserves contract #3's
+        (window offset, sender-major rank) arrival order exactly.
+        Single-chip, no-drop links only; counters and digests match
+        the eager path bit-for-bit."""
+        sc = self.scenario
+        K, M, P = sc.mailbox_cap, sc.max_out, sc.payload_width
+        n = self.comm.n_local
+        n_glob = self.comm.n_global
+        W = self.window
+        # pack (validity, destination-range check) into ONE array so
+        # the per-rung gather moves 1 + P arrays instead of 3 + P —
+        # random-access volume is the branch's dominant cost on this
+        # chip (~4.5 ns/element, profiling/micro2_r05.py). Contract #6
+        # corollary: out-of-range destinations are counted here,
+        # globally, never silently dropped.
+        dst32 = out.dst.astype(jnp.int32)                       # [M, N]
+        dst_okf = (dst32 >= 0) & (dst32 < n_glob)
+        bad_dst_step = jnp.sum(out_valid & ~dst_okf, dtype=jnp.int32)
+        pdst = jnp.where(out_valid & dst_okf, dst32, -1)        # [M, N]
+        sender_live = jnp.any(pdst >= 0, axis=0)                # [N]
+        n_active = jnp.sum(sender_live, dtype=jnp.int32)
+        sid_sorted = jax.lax.sort(
+            jnp.where(sender_live, node_ids, jnp.int32(n)))
+
+        def tail(A):
+            def branch():
+                sids = jax.lax.slice_in_dim(sid_sorted, 0, A)
+                real = sids < n
+                sidc = jnp.where(real, sids, 0)  # safe gather index
+                woff_a = (now_vec[sidc] - t).astype(jnp.int32)  # [A]
+                dst_a = jnp.take(pdst, sidc, axis=1)            # [M, A]
+                pay_a = tuple(jnp.take(out.payload[:, p, :], sidc, axis=1)
+                              for p in range(P))
+                SA = A * M
+                dst_f = dst_a.reshape(SA)
+                ok = (dst_f >= 0) & jnp.broadcast_to(
+                    real[None, :], (M, A)).reshape(SA)
+                smrank = (jnp.broadcast_to(sidc[None, :] * jnp.int32(M),
+                                           (M, A))
+                          + jnp.arange(M, dtype=jnp.int32)[:, None]
+                          ).reshape(SA)
+                sort_dst = jnp.where(ok, dst_f, n)
+                pay_f = tuple(p.reshape(SA) for p in pay_a)
+                if W > 1:
+                    woff_f = jnp.broadcast_to(
+                        woff_a[None, :], (M, A)).reshape(SA)
+                    ops = jax.lax.sort(
+                        (sort_dst, woff_f, smrank) + pay_f,
+                        dimension=0, num_keys=3)
+                    sd, woff_s, smrank_s = ops[0], ops[1], ops[2]
+                    pay_s = ops[3:]
+                else:
+                    ops = jax.lax.sort(
+                        (sort_dst, smrank) + pay_f, dimension=0,
+                        num_keys=2)
+                    sd, smrank_s = ops[0], ops[1]
+                    woff_s = jnp.zeros_like(sd)
+                    pay_s = ops[2:]
+                ok_s = sd < n
+                src_s = smrank_s // jnp.int32(M)
+                tmsg_s = t + woff_s.astype(jnp.int64)
+                # sample only the rung's lanes; invalid lanes are fed
+                # the sentinel and masked (`sample` is elementwise)
+                flight_s, drel_s, bad_delay_step, short_step = \
+                    self._sample_nodrop(src_s, sd, tmsg_s,
+                                        smrank_s % jnp.int32(M),
+                                        woff_s, ok_s)
+                mrel, msrc, mpay, overflow_step = self._insert_sorted(
+                    mb_rel, mb_src, mb_payload, sd, ok_s, drel_s,
+                    src_s, pay_s, free_rows, counts)
+                sent_count = jnp.sum(ok, dtype=jnp.int32)
+                if with_trace:
+                    dt_abs = tmsg_s + flight_s
+                    sent_mix = mix32_jnp(SENT, src_s, sd, _tlo(dt_abs),
+                                         _thi(dt_abs), pay_s[0])
+                    sent_hash = _u32sum(jnp.where(ok_s, sent_mix, 0))
+                else:
+                    sent_hash = jnp.uint32(0)
+                return (mrel, msrc, mpay, overflow_step, bad_dst_step,
+                        bad_delay_step, short_step, sent_count,
+                        sent_hash)
+            return branch
+
+        rungs = self._sender_rungs(n)
+        if len(rungs) == 1:
+            return tail(rungs[0])()
+        idx = jnp.sum(n_active > jnp.asarray(rungs, jnp.int32))
+        return jax.lax.switch(idx, [tail(A) for A in rungs])
 
     def _superstep(self, st: EngineState, with_trace: bool
                    ) -> Tuple[EngineState, Optional[_StepOut]]:
@@ -340,11 +543,30 @@ class JaxEngine:
             free_rows = None
             counts = kept.sum(axis=0, dtype=jnp.int32)          # [N]
 
-        # 6. route outboxes; arrival order is fixed later by the
-        #    (window offset, sender-major rank) keys, so the flatten
-        #    order is free (slot-major — no transpose of the [M, N]
+        # 6. route outboxes — three regimes. Adaptive sender-compacted
+        #    routing (class docstring) never materializes the
+        #    S = N·max_out flattened arrays at all; the legacy paths
+        #    below flatten slot-major (arrival order is fixed later by
+        #    the (window offset, sender-major rank) keys, so the
+        #    flatten order is free — no transpose of the [M, N]
         #    outbox). Each message is stamped with its sender's firing
         #    instant (== t for W == 1), which keys the link entropy.
+        adaptive = (self.route_cap is None
+                    and not self.link.can_drop
+                    and type(comm) is LocalComm
+                    and (W > 1 or M > 1))
+        if adaptive:
+            (mb_rel, mb_src, mb_payload, overflow_step, bad_dst_step,
+             bad_delay_step, short_step, sent_count, sent_hash) = \
+                self._route_adaptive(
+                    out, out_valid, now_vec, t, mb_rel, mb_src,
+                    mb_payload, free_rows, counts, node_ids, with_trace)
+            route_drop_step = jnp.int32(0)
+            return self._finish_superstep(
+                st, live, states, wake, mb_rel, mb_src, mb_payload,
+                deliver, fire, node_ids, t, base,
+                overflow_step, bad_dst_step, bad_delay_step, short_step,
+                route_drop_step, sent_count, sent_hash, with_trace)
         S = n * M
         src_f = jnp.tile(node_ids, M)
         slot_f = jnp.repeat(jnp.arange(M, dtype=jnp.int32), n)
@@ -413,24 +635,15 @@ class JaxEngine:
                 pay_s = opsL[2:]
             ok_s = sd < n
             src_s = smrank_s // jnp.int32(M)
-            slot_s = smrank_s % jnp.int32(M)
             tmsg_s = t + woff_s.astype(jnp.int64)
             # sample the survivors; invalid lanes (sd == n) are fed the
             # sentinel and masked — `sample` is elementwise by contract
-            mbits_s = msg_bits(self.s0, self.s1, src_s, sd, tmsg_s,
-                               slot_s) if self.link.needs_key else None
-            delay_s, _ = self.link.sample(src_s, sd, tmsg_s, mbits_s)
-            flight_s = jnp.maximum(delay_s, jnp.int64(1))  # contract #4
-            drel64_s = woff_s.astype(jnp.int64) + flight_s
-            bad_delay_step = comm.all_sum(jnp.sum(
-                ok_s & (drel64_s > jnp.int64(_I32MAX - 1)),
-                dtype=jnp.int32))
-            short_step = comm.all_sum(jnp.sum(
-                ok_s & (flight_s < W), dtype=jnp.int32)) \
-                if W > 1 else jnp.int32(0)
-            drel_s = jnp.minimum(drel64_s,
-                                 jnp.int64(_I32MAX - 1)).astype(jnp.int32)
-            sent_count_msgs = ok  # full validity mask (counts all sent)
+            flight_s, drel_s, bad_delay_step, short_step = \
+                self._sample_nodrop(src_s, sd, tmsg_s,
+                                    smrank_s % jnp.int32(M), woff_s,
+                                    ok_s)
+            bad_delay_step = comm.all_sum(bad_delay_step)
+            short_step = comm.all_sum(short_step)
             bucket_ovf = jnp.int32(0)
         else:
             mbits = msg_bits(self.s0, self.s1, src_f, dst_f, tmsg,
@@ -481,32 +694,49 @@ class JaxEngine:
             ok_s = sd < n
             src_s = ops3[1] // jnp.int32(M)   # smrank = src * M + slot
             pay_s = ops3[3:]
-            sent_count_msgs = ok
-        rank = group_rank(sd)
-        if sc.commutative_inbox:
-            # r-th incoming message takes the destination's r-th hole
-            prow = free_rows[jnp.clip(rank, 0, K - 1),
-                             jnp.clip(sd, 0, n - 1)]
-            fits = ok_s & (rank < K) & (prow < K)
-            col = jnp.clip(prow, 0, K - 1)
-            pos = jnp.where(fits, jnp.int32(0), jnp.int32(K))  # overflow key
-        else:
-            pos = counts[jnp.clip(sd, 0, n - 1)] + rank
-            fits = ok_s & (pos < K)
-            col = jnp.clip(pos, 0, K - 1)
-        row = jnp.where(fits, sd, n)  # out-of-range row -> dropped scatter
-        mb_rel = mb_rel.at[col, row].set(drel_s, mode="drop")
-        if sc.inbox_src:
-            # inbox_src=False skips this whole scatter — mailbox
-            # scatters ARE the dense random-delivery cost floor
-            # (PERF_r04.md), so dropping an unread field is ~1/3 of it
-            mb_src = mb_src.at[col, row].set(src_s, mode="drop")
-        for p in range(P):
-            mb_payload = mb_payload.at[col, p, row].set(
-                pay_s[p], mode="drop")
-        overflow_step = comm.all_sum(
-            jnp.sum(ok_s & (pos >= K), dtype=jnp.int32)) + bucket_ovf
+        mb_rel, mb_src, mb_payload, overflow_local = self._insert_sorted(
+            mb_rel, mb_src, mb_payload, sd, ok_s, drel_s, src_s, pay_s,
+            free_rows, counts)
+        overflow_step = comm.all_sum(overflow_local) + bucket_ovf
 
+        sent_count = sent_hash = None
+        if with_trace:
+            if lazy:
+                # delays exist only for the sorted/sliced survivors;
+                # with route_drop == 0 (the parity regime) this is
+                # every sent message — and count and hash cover the
+                # SAME (sliced) set even when drops occur
+                dt_abs = tmsg_s + flight_s  # send instant + flight
+                sent_mix = mix32_jnp(SENT, src_s, sd, _tlo(dt_abs),
+                                     _thi(dt_abs), pay_s[0])
+                sent_hash = comm.all_sum(
+                    _u32sum(jnp.where(ok_s, sent_mix, 0)))
+                sent_count = comm.all_sum(
+                    jnp.sum(ok_s, dtype=jnp.int32))
+            else:
+                dt_abs = t + drel64  # send instant + flight time
+                sent_mix = mix32_jnp(SENT, src_f, dst_f, _tlo(dt_abs),
+                                     _thi(dt_abs), pay_cols[0])
+                sent_hash = comm.all_sum(
+                    _u32sum(jnp.where(ok, sent_mix, 0)))
+                sent_count = comm.all_sum(jnp.sum(ok, dtype=jnp.int32))
+        return self._finish_superstep(
+            st, live, states, wake, mb_rel, mb_src, mb_payload,
+            deliver, fire, node_ids, t, base,
+            overflow_step, bad_dst_step, bad_delay_step, short_step,
+            route_drop_step, sent_count, sent_hash, with_trace)
+
+    def _finish_superstep(self, st, live, states, wake, mb_rel, mb_src,
+                          mb_payload, deliver, fire, node_ids, t, base,
+                          overflow_step, bad_dst_step, bad_delay_step,
+                          short_step, route_drop_step, sent_count,
+                          sent_hash, with_trace):
+        """Assemble the post-superstep state and (optionally) the trace
+        row — shared by all three routing regimes. ``sent_count`` /
+        ``sent_hash`` are computed by the caller (their inputs live at
+        regime-specific widths) and may be None when tracing is off."""
+        sc, comm = self.scenario, self.comm
+        K, n = sc.mailbox_cap, comm.n_local
         recv_count = comm.all_sum(jnp.sum(deliver, dtype=jnp.int32))
         new_st = EngineState(
             states=states, wake=wake,
@@ -538,21 +768,6 @@ class JaxEngine:
             _tlo(d_abs), _thi(d_abs),
             st.mb_payload[:, 0, :])
         recv_hash = comm.all_sum(_u32sum(jnp.where(deliver, recv_mix, 0)))
-        if lazy:
-            # delays exist only for the sorted/sliced survivors; with
-            # route_drop == 0 (the parity regime) this is every sent
-            # message
-            dt_abs = tmsg_s + flight_s  # == send instant + flight time
-            sent_mix = mix32_jnp(SENT, src_s, sd, _tlo(dt_abs),
-                                 _thi(dt_abs), pay_s[0])
-            sent_hash = comm.all_sum(_u32sum(jnp.where(ok_s, sent_mix, 0)))
-        else:
-            dt_abs = t + drel64  # == send instant + flight time
-            sent_mix = mix32_jnp(SENT, src_f, dst_f, _tlo(dt_abs),
-                                 _thi(dt_abs), pay_cols[0])
-            sent_hash = comm.all_sum(_u32sum(jnp.where(ok, sent_mix, 0)))
-        sent_count = comm.all_sum(jnp.sum(sent_count_msgs,
-                                          dtype=jnp.int32))
 
         yrow = _StepOut(
             valid=live, t=t,

@@ -56,7 +56,9 @@ class SuperstepOracle:
 
     def __init__(self, scenario: Scenario, link: LinkModel, *,
                  seed: int = 0, record_events: bool = False,
-                 window: int = 1) -> None:
+                 window=1) -> None:
+        if window == "auto":    # mirror JaxEngine: link floor = widest
+            window = max(1, int(link.min_delay_us))  # exact window
         if window < 1:
             raise ValueError(f"window must be >= 1 µs, got {window}")
         if window > 1 and window > link.min_delay_us:
@@ -140,9 +142,14 @@ class SuperstepOracle:
                 break
             self.time = t
             # windowed firing: every node with an event in [t, t+W),
-            # each at its own instant nexts[i] (== t for W == 1)
+            # each at its own instant nexts[i] (== t for W == 1);
+            # an `until` horizon bounds the *instants*, not just the
+            # window start — a W > 1 window straddling `until` fires
+            # only the nodes at or before it (matching the window=1
+            # semantics of the same horizon)
             fired = [i for i in range(n)
-                     if nexts[i] < NEVER and nexts[i] - t < W]
+                     if nexts[i] < NEVER and nexts[i] - t < W
+                     and (until is None or nexts[i] <= until)]
             fired_hash = combine_py(mix32_py(FIRED, i) for i in fired)
             if self.events is not None:
                 self.events.extend(("fire", nexts[i], i) for i in fired)

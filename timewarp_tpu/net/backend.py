@@ -115,18 +115,35 @@ _DRAW_CACHE: Dict[Any, Any] = {}
 
 
 def _jitted_draw(model: "LinkModel"):
-    fn = _DRAW_CACHE.get(model)
+    """Jitted sampler per link model. Falls back to eager per-call
+    sampling when the model is unhashable (a user's non-frozen custom
+    dataclass cannot key the cache) or its ``sample`` is not traceable
+    (e.g. an :class:`FnDelay` written with Python control flow on
+    src/dst/t) — slower per draw, but any ``LinkModel`` that works
+    eagerly keeps working. Built-in models are frozen dataclasses with
+    pure-jnp samplers, so they always take the jitted path."""
+    from ..core.rng import msg_bits
+
+    def sample(s0, s1, src, dst, t, slot):
+        key = msg_bits(s0, s1, src, dst, t, slot) \
+            if model.needs_key else None
+        return model.sample(src, dst, t, key)
+
+    try:
+        fn = _DRAW_CACHE.get(model)
+    except TypeError:           # unhashable user model: never cached
+        return sample
     if fn is None:
         import jax
+        import jax.numpy as jnp
 
-        from ..core.rng import msg_bits
-
-        def sample(s0, s1, src, dst, t, slot):
-            key = msg_bits(s0, s1, src, dst, t, slot) \
-                if model.needs_key else None
-            return model.sample(src, dst, t, key)
-
-        fn = jax.jit(sample)
+        jfn = jax.jit(sample)
+        try:    # trace eagerly so a non-traceable sampler falls back
+            jfn(jnp.uint32(0), jnp.uint32(0), jnp.uint32(0),
+                jnp.uint32(0), jnp.int64(0), jnp.uint32(0))
+            fn = jfn
+        except Exception:
+            fn = sample
         _DRAW_CACHE[model] = fn
     return fn
 

@@ -193,12 +193,19 @@ class Quantize(LinkModel):
     def sample(self, src, dst, t, key):
         d, drop = self.inner.sample(src, dst, t, key)
         q = jnp.int64(self.quantum_us)
+        # clamp BEFORE rounding up: an inner model that samples a raw
+        # 0 µs delay (e.g. UniformDelay(0, hi)) would otherwise
+        # quantize to 0 and ride the engines' >= 1 µs flight clamp,
+        # making the declared min_delay_us (>= quantum) a lie — the
+        # declaration gates windowed-superstep validation, so it must
+        # be a true lower bound of the sampled values
+        d = jnp.maximum(d, jnp.int64(1))
         return ((d + q - 1) // q) * q, drop
 
     @property
     def min_delay_us(self) -> int:
         q = int(self.quantum_us)
-        m = self.inner.min_delay_us
+        m = max(self.inner.min_delay_us, 1)
         return ((m + q - 1) // q) * q
 
     @property
