@@ -1,0 +1,62 @@
+"""Which axis does the 22.8 ms praos superstep scale with?
+
+Run the praos config with one structural knob varied at a time
+(fanout/M, mailbox_cap/K, n) over long windows; the scaling axis
+locates the dominant cost. Usage:
+  python profiling/praos_axes_r05.py [fanout mailbox n_half base]
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from timewarp_tpu.utils import jaxconfig  # noqa: F401
+
+import jax
+
+from timewarp_tpu.interp.jax_engine.engine import JaxEngine
+from timewarp_tpu.models.praos import praos
+from timewarp_tpu.net.delays import LogNormalDelay, Quantize
+
+
+def build(n=1 << 20, fanout=8, mailbox=16):
+    sc = praos(n, slot_us=1_000_000, n_slots=1 << 30,
+               leader_prob=4.0 / n, fanout=fanout, burst=True,
+               mailbox_cap=mailbox)
+    link = Quantize(LogNormalDelay(20_000, 0.6, cap_us=150_000,
+                                   floor_us=8_000), 1_000)
+    return JaxEngine(sc, link, window="auto")
+
+
+def run(name, eng, warm=24, steps=192):
+    st = eng.init_state()
+    st = eng.run_quiet(warm, st)
+    int(st.delivered)
+    t0 = time.perf_counter()
+    fin = eng.run_quiet(steps, st)
+    d = int(fin.delivered) - int(st.delivered)
+    dt = time.perf_counter() - t0
+    ns = int(fin.steps) - int(st.steps)
+    print(json.dumps({"variant": name, "steps": ns,
+                      "ms_per_superstep": round(dt * 1e3 / ns, 2),
+                      "delivered": d}))
+
+
+def main():
+    which = sys.argv[1:] or ["base", "fanout", "mailbox", "n_half"]
+    if "base" in which:
+        run("base n=2^20 M=8 K=16", build())
+    if "fanout" in which:
+        run("fanout=2 (M/4)", build(fanout=2))
+    if "mailbox" in which:
+        run("mailbox_cap=8 (K/2)", build(mailbox=8))
+    if "n_half" in which:
+        run("n=2^19 (N/2)", build(n=1 << 19))
+
+
+if __name__ == "__main__":
+    main()

@@ -86,6 +86,14 @@ class EngineState(NamedTuple):
     delivered: jax.Array   # int64[] — total delivered messages
     steps: jax.Array       # int64[] — supersteps executed
     time: jax.Array        # int64[] — current virtual time == mailbox epoch
+    #: device-side event ring (empty unless ``record_events`` > 0):
+    #: per-event time (fire instant / deliver time) and [kind, node,
+    #: src, payload0] columns; ``ev_count`` counts every event ever
+    #: produced — entries beyond capacity are dropped, and
+    #: ``ev_count > capacity`` IS the overflow evidence (never silent)
+    ev_time: jax.Array     # int64[E]
+    ev_meta: jax.Array     # int32[4, E]
+    ev_count: jax.Array    # int32[]
 
 
 class JaxEngine:
@@ -157,10 +165,13 @@ class JaxEngine:
 
     def __init__(self, scenario: Scenario, link: LinkModel, *,
                  seed: int = 0, window=1,
-                 route_cap: Optional[int] = None) -> None:
+                 route_cap: Optional[int] = None,
+                 record_events: int = 0) -> None:
         if scenario.n_nodes * scenario.max_out >= 2**31:
             raise ValueError(
                 "n_nodes * max_out must fit int32 (sender-major rank)")
+        if record_events < 0:
+            raise ValueError("record_events must be >= 0")
         if window == "auto":
             # widest exact window the link model licenses: every delay
             # is declared >= min_delay_us, so instants within that
@@ -184,6 +195,14 @@ class JaxEngine:
         self.link = link
         self.window = int(window)
         self.route_cap = None if route_cap is None else int(route_cap)
+        #: event-ring capacity (0 = recording off): with it on, every
+        #: superstep appends per-event (time, kind, node, src,
+        #: payload) records on-device — the engine-side mirror of
+        #: ``SuperstepOracle(record_events=True)``, so a digest
+        #: mismatch at scale is debuggable record-by-record without a
+        #: host-oracle rerun (tests/test_event_ring.py asserts
+        #: record-level equality)
+        self.record_events = int(record_events)
         self.s0, self.s1 = seed_words(seed)
         self.comm = LocalComm(scenario.n_nodes)
 
@@ -215,6 +234,9 @@ class JaxEngine:
             delivered=jnp.int64(0),
             steps=jnp.int64(0),
             time=jnp.int64(0),
+            ev_time=jnp.zeros((self.record_events,), jnp.int64),
+            ev_meta=jnp.zeros((4, self.record_events), jnp.int32),
+            ev_count=jnp.int32(0),
         )
 
     # -- one superstep ---------------------------------------------------
@@ -564,7 +586,7 @@ class JaxEngine:
             route_drop_step = jnp.int32(0)
             return self._finish_superstep(
                 st, live, states, wake, mb_rel, mb_src, mb_payload,
-                deliver, fire, node_ids, t, base,
+                deliver, fire, node_ids, t, base, now_vec,
                 overflow_step, bad_dst_step, bad_delay_step, short_step,
                 route_drop_step, sent_count, sent_hash, with_trace)
         S = n * M
@@ -722,22 +744,57 @@ class JaxEngine:
                 sent_count = comm.all_sum(jnp.sum(ok, dtype=jnp.int32))
         return self._finish_superstep(
             st, live, states, wake, mb_rel, mb_src, mb_payload,
-            deliver, fire, node_ids, t, base,
+            deliver, fire, node_ids, t, base, now_vec,
             overflow_step, bad_dst_step, bad_delay_step, short_step,
             route_drop_step, sent_count, sent_hash, with_trace)
 
     def _finish_superstep(self, st, live, states, wake, mb_rel, mb_src,
                           mb_payload, deliver, fire, node_ids, t, base,
-                          overflow_step, bad_dst_step, bad_delay_step,
-                          short_step, route_drop_step, sent_count,
-                          sent_hash, with_trace):
+                          now_vec, overflow_step, bad_dst_step,
+                          bad_delay_step, short_step, route_drop_step,
+                          sent_count, sent_hash, with_trace):
         """Assemble the post-superstep state and (optionally) the trace
-        row — shared by all three routing regimes. ``sent_count`` /
+        row — shared by all routing regimes. ``sent_count`` /
         ``sent_hash`` are computed by the caller (their inputs live at
         regime-specific widths) and may be None when tracing is off."""
         sc, comm = self.scenario, self.comm
         K, n = sc.mailbox_cap, comm.n_local
         recv_count = comm.all_sum(jnp.sum(deliver, dtype=jnp.int32))
+        ev_time, ev_meta, ev_count = st.ev_time, st.ev_meta, st.ev_count
+        if self.record_events:
+            if type(comm) is not LocalComm:
+                raise ValueError(
+                    "record_events is single-chip only (the ring is "
+                    "an unsharded debug artifact)")
+            # append per-event records: fires (ascending node id),
+            # then deliveries (node-major, slot order) — each ring
+            # slot is written at most once over the whole run, and
+            # events past capacity are dropped while ev_count keeps
+            # counting (the overflow evidence)
+            E = self.record_events
+            KN = K * n
+            f32 = fire.astype(jnp.int32)
+            pos_f = ev_count + jnp.cumsum(f32, dtype=jnp.int32) - f32
+            idx_f = jnp.where(fire, pos_f, jnp.int32(E))
+            nf = jnp.sum(f32, dtype=jnp.int32)
+            ev_time = ev_time.at[idx_f].set(now_vec, mode="drop")
+            ev_meta = ev_meta.at[0, idx_f].set(1, mode="drop")
+            ev_meta = ev_meta.at[1, idx_f].set(node_ids, mode="drop")
+            dvT = deliver.T.reshape(KN)                  # node-major
+            d32 = dvT.astype(jnp.int32)
+            pos_r = ev_count + nf + jnp.cumsum(d32, dtype=jnp.int32) - d32
+            idx_r = jnp.where(dvT, pos_r, jnp.int32(E))
+            dtime = (base + st.mb_rel.astype(jnp.int64)).T.reshape(KN)
+            src_r = (st.mb_src if sc.inbox_src
+                     else jnp.zeros_like(st.mb_src)).T.reshape(KN)
+            ev_time = ev_time.at[idx_r].set(dtime, mode="drop")
+            ev_meta = ev_meta.at[0, idx_r].set(2, mode="drop")
+            ev_meta = ev_meta.at[1, idx_r].set(
+                jnp.repeat(node_ids, K), mode="drop")
+            ev_meta = ev_meta.at[2, idx_r].set(src_r, mode="drop")
+            ev_meta = ev_meta.at[3, idx_r].set(
+                st.mb_payload[:, 0, :].T.reshape(KN), mode="drop")
+            ev_count = ev_count + nf + jnp.sum(d32, dtype=jnp.int32)
         new_st = EngineState(
             states=states, wake=wake,
             mb_rel=mb_rel, mb_src=mb_src, mb_payload=mb_payload,
@@ -749,6 +806,7 @@ class JaxEngine:
             delivered=st.delivered + recv_count.astype(jnp.int64),
             steps=st.steps + 1,
             time=t,
+            ev_time=ev_time, ev_meta=ev_meta, ev_count=ev_count,
         )
         # freeze everything once quiesced
         final = jax.tree.map(lambda a, b: jnp.where(live, b, a), st, new_st)
@@ -837,3 +895,29 @@ class JaxEngine:
         per-step host materialization and no digest work compiled in."""
         st = state if state is not None else self.init_state()
         return self._run_while(st, max_steps)
+
+    def events(self, state: EngineState):
+        """Decode the device-side event ring into host tuples —
+        ``("fire", time, node)`` and ``("recv", deliver_time, node,
+        src, payload0)`` — plus the count of events that did NOT fit
+        the ring (0 = the record is complete). The engine-side mirror
+        of ``SuperstepOracle(record_events=True).events``; recv ``src``
+        is 0 for ``inbox_src=False`` scenarios (the field the whole
+        stack elides)."""
+        if not self.record_events:
+            raise ValueError("engine built with record_events=0")
+        ev_time = np.asarray(jax.device_get(state.ev_time))
+        ev_meta = np.asarray(jax.device_get(state.ev_meta))
+        total = int(state.ev_count)
+        filled = min(total, self.record_events)
+        out = []
+        for j in range(filled):
+            kind, node, src, pay = (int(ev_meta[0, j]),
+                                    int(ev_meta[1, j]),
+                                    int(ev_meta[2, j]),
+                                    int(ev_meta[3, j]))
+            if kind == 1:
+                out.append(("fire", int(ev_time[j]), node))
+            else:
+                out.append(("recv", int(ev_time[j]), node, src, pay))
+        return out, total - filled

@@ -166,4 +166,7 @@ class ShardedEngine(ShardedDriver, JaxEngine):
             overflow=P(), bad_dst=P(), bad_delay=P(), short_delay=P(),
             route_drop=P(),
             delivered=P(), steps=P(), time=P(),
+            # the event ring is a single-chip debug artifact
+            # (record_events=0 sharded: zero-size, replicated)
+            ev_time=P(), ev_meta=P(), ev_count=P(),
         )

@@ -28,7 +28,7 @@ import jax.numpy as jnp
 from ..core.scenario import NEVER, Inbox, Outbox, Scenario
 from ..core.time import Microsecond, ms, sec
 from ..net.delays import LinkModel, LogNormalDelay
-from .peers import lcg_peers
+from .peers import distinct_mask, lcg_peers
 
 __all__ = ["gossip", "gossip_links"]
 
@@ -76,12 +76,17 @@ def gossip(n: int, *,
         left1 = jnp.where(got_new & alive, jnp.int32(1), left)
         nxt1 = jnp.where(got_new & alive, now + jnp.int64(think_us), nxt)
 
-        # one firing floods all fanout peers: chained LCG draws
+        # one firing floods all fanout peers: chained LCG draws.
+        # Duplicate draws are masked — a real node pushes a rumor at
+        # most once per peer connection, and distinctness is also what
+        # keeps the net-stack twin µs-identical (same-socket
+        # co-temporal chunks serialize +1 µs under TCP FIFO —
+        # models/gossip_net.py)
         due = (left1 > 0) & (nxt1 <= now) & alive
         lc, dsts = lcg_peers(lcg, i, n, fanout)
         lcg1 = jnp.where(due, lc, lcg)
         out = Outbox(
-            valid=jnp.broadcast_to(due, (fanout,)),
+            valid=due & distinct_mask(dsts),
             dst=jnp.stack(dsts),
             payload=jnp.broadcast_to((hop1 + 1).reshape(1, 1),
                                      (fanout, 1)))

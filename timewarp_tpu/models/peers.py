@@ -13,7 +13,7 @@ from typing import List, Tuple
 
 import jax.numpy as jnp
 
-__all__ = ["LCG_A", "LCG_C", "lcg_peers"]
+__all__ = ["LCG_A", "LCG_C", "lcg_peers", "distinct_mask"]
 
 LCG_A = 1103515245
 LCG_C = 12345
@@ -34,3 +34,22 @@ def lcg_peers(lcg, i, n: int, k: int) -> Tuple[jnp.ndarray, List]:
         dsts.append((i + jnp.int32(1)
                      + (jnp.abs(lc) % jnp.int32(n - 1))) % jnp.int32(n))
     return lc, dsts
+
+
+def distinct_mask(dsts):
+    """First-occurrence mask over a burst's peer draws (scalar per
+    lane, inside vmap): lane a is True iff ``dsts[a]`` did not appear
+    in an earlier lane. Shared by the burst models (gossip, praos) —
+    a real node pushes a tip at most once per peer connection, and
+    distinctness is also what keeps the net-stack twins µs-identical
+    (same-socket co-temporal chunks serialize +1 µs under the emulated
+    fabric's TCP FIFO — models/gossip_net.py). One implementation so
+    the models cannot drift apart bit-wise (both feed parity digests).
+    """
+    uniq = [jnp.bool_(True)]
+    for a in range(1, len(dsts)):
+        dup = dsts[a] == dsts[0]
+        for b in range(1, a):
+            dup = dup | (dsts[a] == dsts[b])
+        uniq.append(~dup)
+    return jnp.stack(uniq)

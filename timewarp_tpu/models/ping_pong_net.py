@@ -11,7 +11,7 @@ each side heard its message.
 
 from __future__ import annotations
 
-from typing import List, Tuple
+from typing import List, Optional, Tuple
 
 from ..core.effects import (GetTime, Program, Wait, fork_,
                             modify_log_name)
@@ -37,12 +37,29 @@ class Pong:
 def ping_pong_net(backend: NetBackend, *,
                   ping_port: int = 4444, pong_port: int = 5555,
                   pong_host: str = "pong-host",
-                  warmup_us: int = 100_000):
+                  warmup_us: int = 100_000,
+                  rounds: int = 1,
+                  send_at: bool = False,
+                  prewarm: bool = False,
+                  events_out: Optional[List[Tuple[str, int]]] = None):
     """Build the scenario's main program; run it under any interpreter.
-    Returns µs times when the ping node got its Pong and the pong node
-    got its Ping. ``pong_host`` defaults to a fabric-only name; pass a
-    resolvable host (e.g. ``localhost``) for the real TCP backend."""
-    events: List[Tuple[str, int]] = []
+    Returns µs times when the ping node got its Pong(s) and the pong
+    node got its Ping(s). ``pong_host`` defaults to a fabric-only name;
+    pass a resolvable host (e.g. ``localhost``) for the real TCP
+    backend.
+
+    ``rounds`` > 1 drives the reference shape repeatedly: every Pong
+    triggers the next Ping *at the same virtual instant* (no think
+    time — the reference's pinger answers immediately, Main.hs:57-67),
+    which is also exactly the batched twin's timing
+    (models/ping_pong.py), so the two worlds need NO translation.
+    ``send_at=True`` anchors the first Ping at the absolute instant
+    ``warmup_us`` (≙ token_ring_net's ``bootstrap_at``) — the
+    cross-world alignment precondition. ``events_out``, when given,
+    collects every ``(tag, t)`` event in order (the returned dict
+    keeps only the last per tag — fine for one round)."""
+    events: List[Tuple[str, int]] = events_out \
+        if events_out is not None else []
     done = Flag()
 
     def main() -> Program:
@@ -63,18 +80,36 @@ def ping_pong_net(backend: NetBackend, *,
             stop = yield from pong_d.listen(AtPort(pong_port),
                                             [Listener(Ping, on_ping)])
             stops.append(stop)
+            if prewarm:
+                # the reply connection opens now, keeping the connect
+                # handshake off the timing path (cross-world alignment)
+                yield from pong_tr.user_state(ping_addr)
 
         def ping_node() -> Program:
             # ≙ the "ping" node (Main.hs:57-67)
+            remaining = [rounds]
+
             def on_pong(msg: Pong, ctx) -> Program:
                 t = yield GetTime()
                 events.append(("ping-got-pong", t))
-                yield from done.set()
+                remaining[0] -= 1
+                if remaining[0] > 0:
+                    # next round at the SAME instant — mirrors the
+                    # batched twin's zero-think reply
+                    yield from ping_d.send(pong_addr, Ping())
+                else:
+                    yield from done.set()
 
             stop = yield from ping_d.listen(AtPort(ping_port),
                                             [Listener(Pong, on_pong)])
             stops.append(stop)
-            yield Wait(warmup_us)  # ≙ wait (for 2 sec), scaled down
+            if prewarm:
+                yield from ping_tr.user_state(pong_addr)
+            if send_at:
+                from ..core.time import till
+                yield Wait(till(warmup_us))  # absolute anchor
+            else:
+                yield Wait(warmup_us)  # ≙ wait (for 2 sec), scaled
             yield from ping_d.send(pong_addr, Ping())
 
         yield from fork_(lambda: modify_log_name("pong", pong_node))

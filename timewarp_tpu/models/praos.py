@@ -29,7 +29,7 @@ import jax.numpy as jnp
 
 from ..core.scenario import NEVER, Inbox, Outbox, Scenario
 from ..core.time import Microsecond, ms, sec
-from .peers import lcg_peers
+from .peers import distinct_mask, lcg_peers
 
 __all__ = ["praos"]
 
@@ -75,7 +75,11 @@ def praos(n: int, *,
         thr_arr = _np.minimum(
             stake.astype(_np.float64) * leader_prob * 4294967296.0,
             2**32 - 1).astype(_np.uint32)
-    thr_j = jnp.asarray(thr_arr)
+    # the threshold rides IN THE STATE, not as a closed-over [n] table:
+    # a vmapped `table[i]` lowers to an N-wide gather, and even
+    # iota-indexed gathers cost ~9 ns/element on this chip (~9 ms at
+    # 1M nodes per superstep — profiling/micro2_r05.py); a state leaf
+    # is a pure elementwise read
 
     def step_burst(state, inbox: Inbox, now, i, key):
         best, lcg = state["best"], state["lcg"]
@@ -90,7 +94,7 @@ def praos(n: int, *,
         # slot boundary: private stake-weighted leadership draw
         due_slot = (slot < jnp.int32(n_slots)) & (nslot <= now)
         b0, _ = key
-        leader = due_slot & (b0 < thr_j[i])
+        leader = due_slot & (b0 < state["thr"])
         best2 = best1 + leader.astype(jnp.int32)
         slot1 = slot + due_slot.astype(jnp.int32)
         nslot1 = jnp.where(due_slot, nslot + jnp.int64(slot_us), nslot)
@@ -101,15 +105,17 @@ def praos(n: int, *,
         lc, dsts = lcg_peers(lcg, i, n, fanout)
         lcg1 = jnp.where(fresh, lc, lcg)
         pay = jnp.stack([best2, i])
+        # duplicate peer draws are masked (one push per peer
+        # connection per tip — peers.distinct_mask)
         out = Outbox(
-            valid=jnp.broadcast_to(fresh, (fanout,)),
+            valid=fresh & distinct_mask(dsts),
             dst=jnp.stack(dsts),
             payload=jnp.broadcast_to(pay, (fanout, 2)))
 
         wake = jnp.where(slot1 < jnp.int32(n_slots), nslot1,
                          jnp.int64(NEVER))
         return {"best": best2, "lcg": lcg1, "slot": slot1,
-                "nslot": nslot1}, out, wake
+                "nslot": nslot1, "thr": state["thr"]}, out, wake
 
     def step(state, inbox: Inbox, now, i, key):
         best, lcg = state["best"], state["lcg"]
@@ -126,7 +132,7 @@ def praos(n: int, *,
         # the firing entropy (≙ the VRF threshold check)
         due_slot = (slot < jnp.int32(n_slots)) & (nslot <= now)
         b0, _ = key
-        leader = due_slot & (b0 < thr_j[i])
+        leader = due_slot & (b0 < state["thr"])
         best2 = best1 + leader.astype(jnp.int32)
         slot1 = slot + due_slot.astype(jnp.int32)
         nslot1 = jnp.where(due_slot, nslot + jnp.int64(slot_us), nslot)
@@ -155,7 +161,7 @@ def praos(n: int, *,
         wake = jnp.minimum(slot_wake, relay_wake)
         return {"best": best2, "lcg": lcg1, "left": left2,
                 "nrelay": nrelay2, "slot": slot1,
-                "nslot": nslot1}, out, wake
+                "nslot": nslot1, "thr": state["thr"]}, out, wake
 
     def init(i: int):
         st = {
@@ -163,6 +169,7 @@ def praos(n: int, *,
             "lcg": jnp.int32((i * 2654435761) % (2**31 - 1) + 1),
             "slot": jnp.int32(0),
             "nslot": jnp.int64(slot_us),
+            "thr": jnp.uint32(thr_arr[i]),
         }
         if not burst:
             st["left"] = jnp.int32(0)
@@ -178,6 +185,7 @@ def praos(n: int, *,
                     % (2**31 - 1) + 1).astype(jnp.int32),
             "slot": jnp.zeros(nn, jnp.int32),
             "nslot": jnp.full(nn, slot_us, jnp.int64),
+            "thr": jnp.asarray(thr_arr),
         }
         if not burst:
             states["left"] = jnp.zeros(nn, jnp.int32)

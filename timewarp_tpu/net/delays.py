@@ -31,7 +31,8 @@ from ..core.rng import bernoulli, normal_f32, split_bits, uniform_int
 
 __all__ = [
     "LinkModel", "FixedDelay", "UniformDelay", "LogNormalDelay",
-    "WithDrop", "FnDelay", "Quantize", "NEVER_CONNECTED",
+    "WithDrop", "FnDelay", "Quantize", "SeededHashUniform",
+    "NEVER_CONNECTED",
 ]
 
 #: Drop probability 1 — ≙ the old API's ``NeverConnected`` outcome.
@@ -211,6 +212,56 @@ class Quantize(LinkModel):
     @property
     def can_drop(self) -> bool:
         return self.inner.can_drop
+
+
+@dataclass(frozen=True)
+class SeededHashUniform(LinkModel):
+    """Uniform ``[lo_us, hi_us]`` delay drawn by a *self-contained*
+    threefry hash of ``(dst, t)`` — the reference's own ``Delays``
+    contract, a seeded deterministic function of destination and time
+    (`/root/reference/examples/token-ring/Main.hs:60, 73-77` draws
+    uniform 1–5 ms from ``mkStdGen 0``).
+
+    ``needs_key = False`` is the point: the draw ignores the
+    transport's chunk/slot sequencing entirely, so the SAME model
+    produces bit-identical delays in the generator-program world (the
+    emulated byte fabric keyed by endpoint ids — ``EmulatedBackend``
+    ``endpoint_ids``) and in the batched-scenario world (node
+    indices) — the alignment the cross-world random-link parity law
+    stands on (tests/test_cross_world.py)."""
+    lo_us: int
+    hi_us: int
+    salt: int = 0
+    needs_key = False
+
+    def __post_init__(self):
+        # expand the salt eagerly: seed_words reads back concrete ints,
+        # which is illegal inside a jit trace
+        from ..core.rng import seed_words
+        s0, s1 = seed_words(self.salt)
+        object.__setattr__(self, "_s0", s0)
+        object.__setattr__(self, "_s1", s1)
+
+    def sample(self, src, dst, t, key):
+        from ..core.rng import threefry2x32, uniform_int
+        s0, s1 = self._s0, self._s1
+        t64 = jnp.asarray(t, jnp.int64)
+        tlo = (t64 & jnp.int64(0xFFFFFFFF)).astype(jnp.uint32)
+        thi = ((t64 >> jnp.int64(32))
+               & jnp.int64(0xFFFFFFFF)).astype(jnp.uint32)
+        d32 = jnp.asarray(dst).astype(jnp.uint32)
+        bits, _ = threefry2x32(jnp.uint32(s0) ^ d32, jnp.uint32(s1),
+                               tlo, thi)
+        d = uniform_int(bits, self.lo_us, self.hi_us)
+        return d, jnp.zeros(jnp.shape(d), bool)
+
+    @property
+    def min_delay_us(self) -> int:
+        return int(self.lo_us)
+
+    @property
+    def can_drop(self) -> bool:
+        return False
 
 
 @dataclass(frozen=True)
