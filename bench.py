@@ -9,9 +9,12 @@ artifacts separate tunnel variance from code changes.
 
 Configs (select with TW_BENCH_CONFIG, default ``token_ring_dense``):
 
-- ``token_ring_dense`` — the headline: dense token ring on the
-  static-topology edge engine (pure neighbor shift, no sort/scatter);
-  the reference's north-star scenario at 1M nodes.
+- ``token_ring_dense`` — the headline: dense token ring on the fused
+  Pallas engine (one kernel per superstep, fused_ring.py), verified
+  in-bench bit-for-bit against the XLA edge engine; the reference's
+  north-star scenario at 1M nodes.
+- ``token_ring_dense_xla`` — the same ring on the XLA edge engine
+  (the pre-fusion baseline).
 - ``token_ring_observer`` — the reference's *actual* token-ring shape
   (observer hub, dynamic destinations) on the general engine.
 - ``gossip_100k`` — push-rumor broadcast, 100k nodes, lognormal
@@ -46,23 +49,64 @@ def _measure(engine, steps, warm_steps=2):
     return delivered, dt, fin
 
 
-def bench_token_ring_dense(n, steps):
-    """Dense ring, think_us=0: a node receiving a token forwards it in
-    the same firing, so every superstep delivers exactly N messages.
-    end_us far enough that the deadline never quiesces the run."""
-    from timewarp_tpu.interp.jax_engine.edge_engine import EdgeEngine
+def _dense_ring(n):
     from timewarp_tpu.models.token_ring import token_ring
     from timewarp_tpu.net.delays import FixedDelay
-
-    n = n or 1 << 20
     sc = token_ring(
         n, n_tokens=n, think_us=0, bootstrap_us=1_000,
         end_us=(1 << 50), with_observer=False, mailbox_cap=4)
-    engine = EdgeEngine(sc, FixedDelay(500), cap=2)
-    # 2048 steps: the tunnel adds a ~120 ms round-trip to the
-    # final readback (profiling/micro2_r05.py), so short runs
-    # under-report by RTT/steps — at ~0.6 ms/superstep, 256
-    # steps would cost ~45% of the true rate
+    return sc, FixedDelay(500)
+
+
+def bench_token_ring_dense(n, steps):
+    """Dense ring, think_us=0, on the fused Pallas engine
+    (interp/jax_engine/fused_ring.py): one kernel per superstep, each
+    state byte touched once. In-bench verification: 12 supersteps on
+    the general EdgeEngine must reproduce the fused state
+    BIT-FOR-BIT before the measured run counts (the fused engine's
+    exactness law, tests/test_fused_ring.py)."""
+    import numpy as np
+    from timewarp_tpu.interp.jax_engine.edge_engine import EdgeEngine
+    from timewarp_tpu.interp.jax_engine.fused_ring import FusedRingEngine
+
+    n = n or 1 << 20
+    sc, link = _dense_ring(n)
+    if n % 8192 != 0:
+        # the fused kernel's pipeline block shape needs n % 8192 == 0
+        # (fused_ring.py); smaller smoke shapes run the XLA engine
+        return bench_token_ring_dense_xla(n, steps)
+    engine = FusedRingEngine(sc, link, cap=2)
+    ref = EdgeEngine(sc, link, cap=2)
+    rs = ref.run_quiet(12)
+    es = engine.to_edge_state(engine.run_quiet(12))
+    for f in ("wake", "q_rel", "q_pay", "delivered", "overflow",
+              "steps", "time"):
+        assert np.array_equal(
+            np.asarray(jax.device_get(getattr(rs, f))),
+            np.asarray(jax.device_get(getattr(es, f)))), \
+            f"fused engine diverged from EdgeEngine on {f}"
+    for leaf in ("cnt", "val", "send_at"):
+        assert np.array_equal(
+            np.asarray(jax.device_get(rs.states[leaf])),
+            np.asarray(jax.device_get(es.states[leaf]))), \
+            f"fused engine diverged from EdgeEngine on state.{leaf}"
+    # 8192 steps: the tunnel adds a ~120 ms round-trip to the final
+    # readback (profiling/micro2_r05.py); at ~0.2 ms/superstep this
+    # keeps the bias under 1%
+    delivered, dt, fin = _measure(engine, steps or 8192)
+    assert int(fin.overflow) == 0, "measured run left the parity regime"
+    return (f"token-ring dense (fused pallas superstep) "
+            f"delivered-messages/sec/chip @{n} nodes", delivered / dt)
+
+
+def bench_token_ring_dense_xla(n, steps):
+    """The same dense ring on the general XLA edge engine — the
+    pre-fusion baseline, kept measurable."""
+    from timewarp_tpu.interp.jax_engine.edge_engine import EdgeEngine
+
+    n = n or 1 << 20
+    sc, link = _dense_ring(n)
+    engine = EdgeEngine(sc, link, cap=2)
     delivered, dt, fin = _measure(engine, steps or 2048)
     # in-bench proof the measured run is in the parity regime: per-edge
     # capacity legitimately diverges from the oracle under overflow
@@ -71,8 +115,8 @@ def bench_token_ring_dense(n, steps):
     for counter in ("overflow", "misrouted", "unrouted", "bad_delay"):
         v = int(getattr(fin, counter))
         assert v == 0, f"measured run left the parity regime: {counter}={v}"
-    return (f"token-ring dense delivered-messages/sec/chip @{n} nodes",
-            delivered / dt)
+    return (f"token-ring dense (xla edge engine) "
+            f"delivered-messages/sec/chip @{n} nodes", delivered / dt)
 
 
 def bench_token_ring_observer(n, steps):
@@ -191,6 +235,7 @@ def bench_praos_1m(n, steps):
 
 CONFIGS = {
     "token_ring_dense": bench_token_ring_dense,
+    "token_ring_dense_xla": bench_token_ring_dense_xla,
     "token_ring_observer": bench_token_ring_observer,
     "gossip_100k": bench_gossip_100k,
     "gossip_steady_1m": bench_gossip_steady_1m,

@@ -11,7 +11,8 @@ import bench
 
 @pytest.mark.parametrize("cfg", sorted(bench.CONFIGS))
 def test_bench_config_runs(cfg):
-    n = {"token_ring_dense": 512, "token_ring_observer": 256,
+    n = {"token_ring_dense": 512, "token_ring_dense_xla": 512,
+         "token_ring_observer": 256,
          "gossip_100k": 512, "gossip_steady_1m": 512,
          "praos_1m": 512}[cfg]
     # gossip_100k runs one wave to quiescence and asserts it got there

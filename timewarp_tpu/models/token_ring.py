@@ -183,7 +183,7 @@ def token_ring(n_ring: int, *,
         static_dst=static_dst,
         commutative_inbox=not with_observer,
         meta={"n_ring": n_ring, "obs_id": obs_id if with_observer else None,
-              "end_us": end_us},
+              "think_us": think_us, "end_us": end_us},
     )
 
 
