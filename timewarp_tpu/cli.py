@@ -76,6 +76,13 @@ def build_scenario(args):
 def build_engine(args, sc, link):
     # never-silent: reject knobs an engine would ignore rather than
     # letting cross-engine comparisons diverge mysteriously
+    if args.engine != "general" and args.record_events:
+        raise SystemExit(
+            f"--record-events is the general engine's device-side "
+            f"ring; {args.engine} does not carry one (the oracle "
+            "records host-side via SuperstepOracle(record_events=True))")
+    if args.events_csv and not args.record_events:
+        raise SystemExit("--events-csv needs --record-events")
     if args.engine in ("edge", "sharded-edge") and args.window != 1:
         raise SystemExit(
             f"--window applies to the general engines only; "
@@ -92,7 +99,8 @@ def build_engine(args, sc, link):
     if args.engine == "general":
         from .interp.jax_engine.engine import JaxEngine
         return JaxEngine(sc, link, seed=args.seed, window=args.window,
-                         route_cap=args.route_cap)
+                         route_cap=args.route_cap,
+                         record_events=args.record_events)
     if args.engine == "edge":
         from .interp.jax_engine.edge_engine import EdgeEngine
         return EdgeEngine(sc, link, seed=args.seed, cap=args.edge_cap)
@@ -152,6 +160,13 @@ def main(argv=None) -> int:
     p.add_argument("--slots", type=int, default=10)
     p.add_argument("--leader-prob", type=float, default=0.05)
     p.add_argument("--trace-csv", default=None)
+    p.add_argument("--record-events", type=int, default=0,
+                   help="general engine: device-side event ring "
+                        "capacity (per-event records; dropped-beyond-"
+                        "capacity is counted, never silent)")
+    p.add_argument("--events-csv", default=None,
+                   help="write the recorded events (needs "
+                        "--record-events)")
     p.add_argument("--save", default=None,
                    help="write the final engine state to this .npz")
     p.add_argument("--resume", default=None,
@@ -194,6 +209,19 @@ def main(argv=None) -> int:
         final_info = {"overflow": int(final.overflow),
                       "steps": int(final.steps),
                       "virtual_time_us": int(final.time)}
+
+    if args.events_csv:
+        import csv
+        records, dropped = engine.events(final)
+        with open(args.events_csv, "w", newline="") as f:
+            w = csv.writer(f)
+            w.writerow(["kind", "time_us", "node", "src", "payload0"])
+            for r in records:
+                # fire records have no src/payload: pad so the file
+                # stays rectangular under the 5-column header
+                w.writerow(tuple(r) + ("",) * (5 - len(r)))
+        if dropped:
+            print(json.dumps({"events_dropped_over_capacity": dropped}))
 
     if args.trace_csv:
         import csv
