@@ -13,7 +13,9 @@ translation (ping-pong replies and gossip relays are instant-exact in
 both worlds by construction).
 
 Together with tests/test_cross_world.py (token-ring, fixed + random)
-this gives three of the five baseline configs cross-world legs.
+this gives FOUR of the five baseline configs cross-world legs
+(ping-pong, gossip, and praos here; socket-state's reconnect
+machinery has no batched twin).
 """
 
 import pytest
@@ -27,6 +29,9 @@ from timewarp_tpu.models.gossip_net import (gossip_net,
                                             host_lcg_peers, lcg_init)
 from timewarp_tpu.models.ping_pong import ping_pong
 from timewarp_tpu.models.ping_pong_net import ping_pong_net
+from timewarp_tpu.models.praos import praos
+from timewarp_tpu.models.praos_net import (leader_schedule, praos_net,
+                                           praos_net_ports)
 from timewarp_tpu.net.backend import EmulatedBackend, endpoint_id
 from timewarp_tpu.net.delays import FixedDelay, SeededHashUniform
 from timewarp_tpu.trace.events import assert_traces_equal
@@ -181,5 +186,82 @@ def test_gossip_cross_world_identical(gossip_net_world,
 
 def test_gossip_engine_matches_oracle(gossip_batched_world):
     _, otrace, state, etrace = gossip_batched_world
+    assert_traces_equal(otrace, etrace)
+    assert int(state.overflow) == 0
+
+
+# ------------------------------------------------------------------- praos
+
+P_N = 24
+P_SLOT = 200_000
+P_SLOTS = 4
+P_PROB = 0.1
+P_FAN = 3
+P_DUR = (P_SLOTS + 1) * P_SLOT
+
+
+@pytest.fixture(scope="module")
+def praos_net_world():
+    for port in range(49152, 49152 + 30 * P_N + 16):
+        assert endpoint_id(f"127.0.0.1:{port}") > P_N
+    receipts = []
+    backend = EmulatedBackend(RND, connect_delays=FixedDelay(500),
+                              seed=0, endpoint_ids=praos_net_ports(P_N))
+    best = run_emulation(praos_net(
+        backend, P_N, seed=0, slot_us=P_SLOT, n_slots=P_SLOTS,
+        leader_prob=P_PROB, fanout=P_FAN, receipts=receipts))
+    return best, sorted((t, i, ln) for t, i, ln in receipts
+                        if t < P_DUR)
+
+
+@pytest.fixture(scope="module")
+def praos_batched_world():
+    sc = praos(P_N, slot_us=P_SLOT, n_slots=P_SLOTS,
+               leader_prob=P_PROB, fanout=P_FAN, burst=True,
+               mailbox_cap=16)
+    oracle = SuperstepOracle(sc, RND, record_events=True)
+    otrace = oracle.run(4000)
+    engine = JaxEngine(sc, RND)
+    state, etrace = engine.run(4000)
+    return oracle, otrace, state, etrace
+
+
+def test_praos_tie_preconditions(praos_net_world):
+    """The worlds are only comparable when no node faces two
+    same-instant events whose fold order matters (module docstring of
+    models/praos_net.py): same-(node, instant) arrivals must carry
+    equal lengths, and no leader's slot boundary may coincide with an
+    arrival. Asserted, not assumed."""
+    _, receipts = praos_net_world
+    sched = leader_schedule(0, P_N, P_SLOTS, P_SLOT, P_PROB)
+    by_key = {}
+    for t, i, ln in receipts:
+        by_key.setdefault((t, i), set()).add(ln)
+    assert all(len(v) == 1 for v in by_key.values())
+    for (t, i) in by_key:
+        assert not (t in sched and i in sched[t])
+
+
+def test_praos_cross_world_identical(praos_net_world,
+                                     praos_batched_world):
+    """Every delivered tip's (time, node, chain length) — and the
+    final per-node chain lengths — identical across the worlds. The
+    leadership schedule is shared by construction (the same
+    counter-RNG draw, host-callable), so the worlds share only the
+    seed, the link model, and the protocol."""
+    import numpy as np
+    best, receipts = praos_net_world
+    oracle, _, state, _ = praos_batched_world
+    recvs = sorted((e[4], e[2], e[5]) for e in oracle.events
+                   if e[0] == "recv" and e[4] < P_DUR)
+    assert recvs == receipts
+    assert len(recvs) > P_N  # tips actually diffused
+    bat_best = np.asarray(state.states["best"])
+    assert [best[i] for i in range(P_N)] == bat_best.tolist()
+    assert int(state.overflow) == 0
+
+
+def test_praos_engine_matches_oracle(praos_batched_world):
+    _, otrace, state, etrace = praos_batched_world
     assert_traces_equal(otrace, etrace)
     assert int(state.overflow) == 0

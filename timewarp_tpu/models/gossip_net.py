@@ -70,6 +70,14 @@ def lcg_init(i: int) -> int:
     return (i * 2654435761) % (2**31 - 1) + 1
 
 
+def host_distinct(dsts):
+    """First-occurrence peer dedup — the host replica of
+    :func:`timewarp_tpu.models.peers.distinct_mask` (one push per
+    peer connection per tip). One implementation for all net twins
+    so they cannot drift from each other or the batched mask."""
+    return list(dict.fromkeys(dsts))
+
+
 @message
 class Rumor:
     """One push-relay hop; ``hop`` is the relay depth."""
@@ -104,11 +112,8 @@ def gossip_net(backend: NetBackend, n: int, *,
             # batched twin's masked lanes — one push per peer), so
             # connections can be prewarmed
             _, dsts = host_lcg_peers(lcg_init(i), i, n, fanout)
-            seen = []
-            for j in dsts:
-                if j not in seen:
-                    seen.append(j)
-            addrs = [(localhost, GOSSIP_PORT0 + j) for j in seen]
+            addrs = [(localhost, GOSSIP_PORT0 + j)
+                     for j in host_distinct(dsts)]
 
             def flood() -> Program:
                 for a in addrs:
