@@ -307,7 +307,7 @@ class JaxEngine:
         if sc.commutative_inbox:
             # r-th incoming message takes the destination's r-th hole
             prow = free_rows[jnp.clip(rank, 0, K - 1),
-                             jnp.clip(sd, 0, n - 1)]
+                             jnp.clip(sd, 0, n - 1)].astype(jnp.int32)
             fits = ok_s & (rank < K) & (prow < K)
             col = jnp.clip(prow, 0, K - 1)
             pos = jnp.where(fits, jnp.int32(0), jnp.int32(K))
@@ -368,13 +368,16 @@ class JaxEngine:
         n_active = jnp.sum(sender_live, dtype=jnp.int32)
         sid_sorted = jax.lax.sort(
             jnp.where(sender_live, node_ids, jnp.int32(n)))
+        # precomputed int32 in-window offsets: the branches gather one
+        # int32 word per sender instead of an int64
+        woff_n = (now_vec - t).astype(jnp.int32)                # [N]
 
         def tail(A):
             def branch():
                 sids = jax.lax.slice_in_dim(sid_sorted, 0, A)
                 real = sids < n
                 sidc = jnp.where(real, sids, 0)  # safe gather index
-                woff_a = (now_vec[sidc] - t).astype(jnp.int32)  # [A]
+                woff_a = woff_n[sidc]                           # [A]
                 dst_a = jnp.take(pdst, sidc, axis=1)            # [M, A]
                 pay_a = tuple(jnp.take(out.payload[:, p, :], sidc, axis=1)
                               for p in range(P))
@@ -550,8 +553,12 @@ class JaxEngine:
             mb_src = st.mb_src          # stale in holes; validity is the
             mb_payload = st.mb_payload  # rel sentinel, never these
             #: free_rows[r, i] = row of node i's r-th free slot (K = none)
+            # int8 free-slot table when K fits: 4x less sort
+            # bandwidth AND 4x smaller as a routing-switch operand
+            # (TPU conditionals move their operands)
+            fr_dt = jnp.int8 if K <= 127 else jnp.int32
             free_rows = jax.lax.sort(
-                jnp.where(keep, jnp.int32(K), slots), dimension=0)
+                jnp.where(keep, K, slots).astype(fr_dt), dimension=0)
             counts = None
         else:
             ops2 = jax.lax.sort(
