@@ -59,3 +59,8 @@ def test_profiling_script_runs():
                       env_extra={"TW_PROF_NODES": "512",
                                  "TW_PROF_REPS": "1"})
     assert '"FULL superstep (while_loop)"' in out
+
+
+def test_cross_world_example():
+    out = run_example("examples/cross_world.py", "--nodes", "12")
+    assert "CROSS-WORLD LAW HOLDS" in out
