@@ -22,13 +22,23 @@ Configs (select with TW_BENCH_CONFIG, default ``token_ring_dense``):
   time-bucketed batching) on the general engine.
 - ``praos_1m`` — Ouroboros-Praos slot-leader consensus at 1M stake
   nodes, general engine, quantized lognormal links.
+- ``gossip_100k_fused`` / ``praos_1m_fused`` — the same two sparse
+  workloads on the fused-sparse Pallas engine (fused_sparse.py, round
+  6), gated in-bench by bit-exact state equality against the XLA
+  general engine before the measured run counts.
 
 Env knobs: TW_BENCH_CONFIG, TW_BENCH_NODES (config-default), and
 TW_BENCH_STEPS (supersteps in the measured window).
+
+``python bench.py --smoke`` is the CI fast path: every config at tiny
+N with all in-bench exactness gates on (fused ring AND fused sparse),
+one JSON line per config — a kernel regression fails CI before a full
+bench round ever runs.
 """
 
 import json
 import os
+import sys
 import time
 
 from timewarp_tpu.utils import jaxconfig  # noqa: F401
@@ -139,48 +149,95 @@ def bench_token_ring_observer(n, steps):
             f"delivered-messages/sec/chip @{n} nodes", delivered / dt)
 
 
+def _gossip_wave(n):
+    """The gossip-wave workload: burst relays (all fanout peers in one
+    firing — how a real node pushes over parallel connections) + an
+    8 ms propagation floor licensing an 8-instant superstep window —
+    the time-bucketed batching answer to the sparse broadcast ramp
+    (JaxEngine.window)."""
+    from timewarp_tpu.models.gossip import gossip, gossip_links
+    from timewarp_tpu.net.delays import Quantize
+    sc = gossip(n, fanout=8, think_us=2_000, burst=True,
+                end_us=5_000_000, mailbox_cap=16)
+    link = Quantize(gossip_links(median_us=20_000, sigma=0.6,
+                                 floor_us=8_000), 1_000)
+    return sc, link
+
+
+def _assert_wave_done(engine, fin, n):
+    """Genuine quiescence, not a window or deadline artifact: no
+    events pending, the parity-regime counters are 0, and the
+    epidemic covered the network up to the push-only miss floor (a
+    node is missed with prob ~e^-fanout = e^-8 ≈ 3e-4; demanding
+    literal 100% would assert against probability theory)."""
+    import numpy as np
+    from timewarp_tpu.core.scenario import NEVER
+    assert int(engine._next_event(fin)) >= NEVER, \
+        "broadcast did not quiesce inside the step budget"
+    assert int(fin.short_delay) == 0, "windowed run left the exact regime"
+    assert int(fin.route_drop) == 0, "routing dropped messages"
+    hops = np.asarray(jax.device_get(fin.states["hop"]))
+    missed = int((hops < 0).sum())
+    assert missed <= max(n // 500, 8), \
+        f"wave truncated: {missed} nodes never infected"
+
+
+def _assert_fused_sparse_exact(fused, ref, gate_steps=12):
+    """The fused-sparse engine's in-bench exactness gate: the XLA
+    general engine must reproduce the fused EngineState BIT-FOR-BIT
+    over the gate horizon before any measured run counts
+    (tests/test_fused_sparse.py is the CPU-side law; this runs it on
+    the bench hardware)."""
+    from timewarp_tpu.trace.events import assert_states_equal
+    fs = fused.run_quiet(gate_steps)
+    rs = ref.run_quiet(gate_steps)
+    assert_states_equal(rs, fs, "in-bench fused-sparse gate")
+
+
 def bench_gossip_100k(n, steps):
     """One full broadcast wave, measured start to quiescence (the
     while_loop exits when the epidemic dies, so a large step budget
     costs nothing): whole-run average msg/s, ramp-up included."""
     from timewarp_tpu.interp.jax_engine.engine import JaxEngine
-    from timewarp_tpu.models.gossip import gossip, gossip_links
-    from timewarp_tpu.net.delays import Quantize
 
     n = n or 100_000
-    # burst relays (all fanout peers in one firing — how a real node
-    # pushes over parallel connections) + an 8 ms propagation floor
-    # licensing an 8-instant superstep window: the time-bucketed
-    # batching answer to the sparse broadcast ramp (JaxEngine.window)
-    sc = gossip(n, fanout=8, think_us=2_000, burst=True,
-                end_us=5_000_000, mailbox_cap=16)
-    link = Quantize(gossip_links(median_us=20_000, sigma=0.6,
-                                 floor_us=8_000), 1_000)
+    sc, link = _gossip_wave(n)
     # window="auto" derives the widest exact window from the link's
     # declared 8 ms floor; adaptive sender-compacted routing (no
     # route_cap) sizes the insertion stage per superstep on-device —
     # no hand-measured capacity constants (VERDICT r4 item 6)
     engine = JaxEngine(sc, link, window="auto")
     delivered, dt, fin = _measure(engine, steps or (1 << 20))
-    # genuine quiescence, not a window or deadline artifact: no events
-    # pending, and the epidemic covered the network up to the push-only
-    # miss floor (a node is missed with prob ~e^-fanout = e^-8 ≈ 3e-4;
-    # demanding literal 100% would assert against probability theory)
-    import numpy as np
-    from timewarp_tpu.core.scenario import NEVER
-    assert int(engine._next_event(fin)) >= NEVER, \
-        "broadcast did not quiesce inside the step budget"
-    assert int(fin.short_delay) == 0, "windowed run left the exact regime"
-    # adaptive routing's top ladder rung covers every sender, so a
-    # nonzero count here can only mean the engine regressed onto a
-    # capped path — an invariant check, not a tuning-knob guard
-    assert int(fin.route_drop) == 0, "adaptive routing dropped messages"
-    hops = np.asarray(jax.device_get(fin.states["hop"]))
-    missed = int((hops < 0).sum())
-    assert missed <= max(n // 500, 8), \
-        f"wave truncated: {missed} nodes never infected"
+    _assert_wave_done(engine, fin, n)
     return (f"gossip broadcast wave to quiescence (lognormal links) "
             f"delivered-messages/sec/chip @{n} nodes", delivered / dt)
+
+
+def bench_gossip_100k_fused(n, steps):
+    """The same wave on the fused-sparse Pallas engine
+    (interp/jax_engine/fused_sparse.py): the compacted batch stays
+    VMEM-resident through sample → bucket → hole-ranked insertion and
+    the mailbox planes stream through the kernel once. Gated in-bench
+    by bit-exact state equality against the XLA general engine."""
+    from timewarp_tpu.interp.jax_engine.engine import JaxEngine
+    from timewarp_tpu.interp.jax_engine.fused_sparse import \
+        FusedSparseEngine
+
+    n = n or 100_000
+    sc, link = _gossip_wave(n)
+    # max_batch bounds the VMEM-resident batch (1<<18 messages = 32k
+    # burst senders/superstep); a wave peak beyond it lands in
+    # route_drop and fails _assert_wave_done loudly — never a silently
+    # wrong number
+    engine = FusedSparseEngine(sc, link, window="auto",
+                               max_batch=1 << 18)
+    _assert_fused_sparse_exact(engine, JaxEngine(sc, link,
+                                                 window="auto"))
+    delivered, dt, fin = _measure(engine, steps or (1 << 20))
+    _assert_wave_done(engine, fin, n)
+    return (f"gossip broadcast wave to quiescence (fused-sparse "
+            f"pallas) delivered-messages/sec/chip @{n} nodes",
+            delivered / dt)
 
 
 def bench_gossip_steady_1m(n, steps):
@@ -204,23 +261,28 @@ def bench_gossip_steady_1m(n, steps):
             f"delivered-messages/sec/chip @{n} nodes", delivered / dt)
 
 
-def bench_praos_1m(n, steps):
-    from timewarp_tpu.interp.jax_engine.engine import JaxEngine
+def _praos_consensus(n):
+    """The praos workload: burst diffusion (a fresh tip floods all
+    fanout peers in one firing) + 8 ms propagation floor + 8 ms
+    window — adoption instants spread by lognormal delays batch 8
+    grid instants per superstep (exact — engine.py JaxEngine.window).
+    The 150 ms delay cap bounds the straggler tail (a 60 s praos
+    relay is not a network, it is an outage)."""
     from timewarp_tpu.models.praos import praos
     from timewarp_tpu.net.delays import LogNormalDelay, Quantize
-
-    n = n or 1 << 20
-    # burst diffusion (a fresh tip floods all fanout peers in one
-    # firing) + 8 ms propagation floor + 8 ms window: adoption
-    # instants spread by lognormal delays batch 8 grid instants per
-    # superstep (exact — engine.py JaxEngine.window)
     sc = praos(n, slot_us=1_000_000, n_slots=1 << 30,
                leader_prob=4.0 / n, fanout=8, burst=True,
                mailbox_cap=16)
-    # 150 ms delay cap bounds the straggler tail (a 60 s praos relay
-    # is not a network, it is an outage)
     link = Quantize(LogNormalDelay(20_000, 0.6, cap_us=150_000,
                                    floor_us=8_000), 1_000)
+    return sc, link
+
+
+def bench_praos_1m(n, steps):
+    from timewarp_tpu.interp.jax_engine.engine import JaxEngine
+
+    n = n or 1 << 20
+    sc, link = _praos_consensus(n)
     # window="auto" (link's 8 ms floor) + adaptive routing: no
     # hand-measured capacity constants (VERDICT r4 item 6)
     engine = JaxEngine(sc, link, window="auto")
@@ -233,13 +295,52 @@ def bench_praos_1m(n, steps):
             delivered / dt)
 
 
+def bench_praos_1m_fused(n, steps):
+    """Praos on the fused-sparse Pallas engine, exactness-gated
+    against the XLA general engine in-bench (see
+    bench_gossip_100k_fused)."""
+    from timewarp_tpu.interp.jax_engine.engine import JaxEngine
+    from timewarp_tpu.interp.jax_engine.fused_sparse import \
+        FusedSparseEngine
+
+    n = n or 1 << 20
+    sc, link = _praos_consensus(n)
+    engine = FusedSparseEngine(sc, link, window="auto",
+                               max_batch=1 << 17)
+    _assert_fused_sparse_exact(engine, JaxEngine(sc, link,
+                                                 window="auto"))
+    delivered, dt, fin = _measure(engine, steps or 256, warm_steps=16)
+    assert int(fin.short_delay) == 0, "windowed run left the exact regime"
+    assert int(fin.route_drop) == 0, \
+        "fused batch cap dropped messages — raise max_batch"
+    return (f"praos slot-leader consensus (fused-sparse pallas) "
+            f"delivered-messages/sec/chip @{n} stake nodes",
+            delivered / dt)
+
+
 CONFIGS = {
     "token_ring_dense": bench_token_ring_dense,
     "token_ring_dense_xla": bench_token_ring_dense_xla,
     "token_ring_observer": bench_token_ring_observer,
     "gossip_100k": bench_gossip_100k,
+    "gossip_100k_fused": bench_gossip_100k_fused,
     "gossip_steady_1m": bench_gossip_steady_1m,
     "praos_1m": bench_praos_1m,
+    "praos_1m_fused": bench_praos_1m_fused,
+}
+
+#: --smoke shapes: every config tiny enough for a CPU CI runner, all
+#: in-bench exactness gates live (the fused ring's 8192-node floor
+#: pins that row's size; the fused-sparse rows gate at 2048)
+SMOKE = {
+    "token_ring_dense": (8192, 16),
+    "token_ring_dense_xla": (4096, 32),
+    "token_ring_observer": (1024, 32),
+    "gossip_100k": (2048, 1 << 14),
+    "gossip_100k_fused": (2048, 1 << 14),
+    "gossip_steady_1m": (4096, 16),
+    "praos_1m": (2048, 24),
+    "praos_1m_fused": (2048, 24),
 }
 
 
@@ -268,7 +369,25 @@ def _calibrate():
     return {"kernel": "sort_1m_int32_x64", "seconds": round(dt, 4)}
 
 
+def smoke() -> None:
+    """CI fast path: every config at its SMOKE shape, exactness gates
+    on, one JSON line each. Throughput numbers at smoke scale are
+    meaningless and marked so — the value of this mode is that a
+    kernel-vs-engine divergence or a broken parity-regime invariant
+    raises before a full bench round ever runs."""
+    for cfg, (n, steps) in SMOKE.items():
+        t0 = time.perf_counter()
+        metric, _ = CONFIGS[cfg](n, steps)
+        print(json.dumps({
+            "config": cfg, "metric": metric, "smoke": True,
+            "ok": True, "seconds": round(time.perf_counter() - t0, 1),
+        }), flush=True)
+
+
 def main() -> None:
+    if "--smoke" in sys.argv:
+        smoke()
+        return
     cfg = os.environ.get("TW_BENCH_CONFIG", "token_ring_dense")
     n = int(os.environ.get("TW_BENCH_NODES", 0)) or None
     steps = int(os.environ.get("TW_BENCH_STEPS", 0)) or None

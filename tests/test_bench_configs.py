@@ -11,12 +11,15 @@ import bench
 
 @pytest.mark.parametrize("cfg", sorted(bench.CONFIGS))
 def test_bench_config_runs(cfg):
+    # the fused-sparse configs sit at the kernel's 1024-lane scope
+    # floor (2048 = the --smoke shape, gate included)
     n = {"token_ring_dense": 512, "token_ring_dense_xla": 512,
          "token_ring_observer": 256,
-         "gossip_100k": 512, "gossip_steady_1m": 512,
-         "praos_1m": 512}[cfg]
-    # gossip_100k runs one wave to quiescence and asserts it got there
-    steps = 20_000 if cfg == "gossip_100k" else 48
+         "gossip_100k": 512, "gossip_100k_fused": 2048,
+         "gossip_steady_1m": 512,
+         "praos_1m": 512, "praos_1m_fused": 2048}[cfg]
+    # the gossip waves run to quiescence and assert they got there
+    steps = 20_000 if cfg.startswith("gossip_100k") else 48
     metric, rate = bench.CONFIGS[cfg](n, steps)
     assert rate > 0
     assert str(n) in metric

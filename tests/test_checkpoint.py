@@ -45,6 +45,32 @@ def test_edge_engine_disk_resume_parity(tmp_path):
         np.concatenate([first.times, rest.times]), full.times)
 
 
+def test_checkpoint_widens_int32_counter_leaf(tmp_path):
+    """A pre-round-6 checkpoint carries ev_count as int32; the widened
+    int64 layout must resume it bit-identically via the one sanctioned
+    lossless conversion (utils/checkpoint.py) — and a genuine dtype
+    mismatch (narrowing) must still fail loudly."""
+    import jax.numpy as jnp
+    sc = token_ring(48, n_tokens=8, think_us=2_000, bootstrap_us=1000,
+                    end_us=200_000, with_observer=True, mailbox_cap=16)
+    link = token_ring_links(48)
+    eng = JaxEngine(sc, link)
+    _, full = eng.run(300)
+    mid, first = eng.run(120)
+    old = mid._replace(ev_count=jnp.asarray(mid.ev_count, jnp.int32))
+    path = tmp_path / "pre_r6.npz"
+    save_state(str(path), old)
+    loaded, _ = load_state(str(path), eng.init_state())
+    assert np.asarray(loaded.ev_count).dtype == np.int64
+    _, rest = eng.run(180, state=loaded)
+    assert np.array_equal(
+        np.concatenate([first.times, rest.times]), full.times)
+    # narrowing is NOT sanctioned: int64 saved vs int32 template
+    save_state(str(path), mid)
+    with pytest.raises(ValueError, match="does not match template"):
+        load_state(str(path), old)
+
+
 def test_checkpoint_rejects_mismatched_config(tmp_path):
     sc = token_ring(32, n_tokens=8, with_observer=False)
     eng = EdgeEngine(sc, UniformDelay(200, 900))

@@ -4,7 +4,11 @@ configurations nobody hand-picked."""
 
 import jax.numpy as jnp
 import numpy as np
-from hypothesis import given, settings, strategies as st
+import pytest
+
+hypothesis = pytest.importorskip(
+    "hypothesis", reason="property suite needs hypothesis")
+from hypothesis import given, settings, strategies as st  # noqa: E402
 
 from timewarp_tpu.core.scenario import NEVER, Inbox, Outbox, Scenario
 from timewarp_tpu.interp.jax_engine.edge_engine import EdgeEngine

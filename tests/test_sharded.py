@@ -195,14 +195,16 @@ def test_meshcomm_roll_matches_global_roll():
     from functools import partial
     from jax.sharding import PartitionSpec as P
 
+    from timewarp_tpu.parallel.mesh import _smap
+
     mesh = mesh8()
     n = 64
     x = jnp.arange(n, dtype=jnp.int32) * 3 + 1
     comm = MeshComm("nodes", n, 8)
     for s in (0, 1, 5, 8, 10, 17, 63):
-        rolled = jax.jit(jax.shard_map(
-            partial(comm.roll, s=s), mesh=mesh,
-            in_specs=P("nodes"), out_specs=P("nodes")))(x)
+        rolled = jax.jit(_smap(
+            partial(comm.roll, s=s), mesh,
+            P("nodes"), P("nodes")))(x)
         assert np.array_equal(np.asarray(rolled),
                               np.asarray(jnp.roll(x, s))), s
 

@@ -6,8 +6,12 @@ Random times are bounded to 10 minutes like the reference's Arbitrary
 instance (test/Test/Control/TimeWarp/Common.hs:27-29).
 """
 
-from hypothesis import given
-from hypothesis import strategies as st
+import pytest
+
+hypothesis = pytest.importorskip(
+    "hypothesis", reason="property suite needs hypothesis")
+from hypothesis import given  # noqa: E402
+from hypothesis import strategies as st  # noqa: E402
 
 from timewarp_tpu import (PureEmulation, ThreadKilled, TimeoutExpired, after,
                           at, for_, fork, fork_, invoke, kill_thread, now,

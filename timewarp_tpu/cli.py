@@ -76,7 +76,8 @@ def build_scenario(args):
 def build_engine(args, sc, link):
     # never-silent: reject knobs an engine would ignore rather than
     # letting cross-engine comparisons diverge mysteriously
-    if args.engine != "general" and args.record_events:
+    if args.engine not in ("general", "fused-sparse") \
+            and args.record_events:
         raise SystemExit(
             f"--record-events is the general engine's device-side "
             f"ring; {args.engine} does not carry one (the oracle "
@@ -87,11 +88,19 @@ def build_engine(args, sc, link):
         raise SystemExit(
             f"--window applies to the general engines only; "
             f"{args.engine} runs classic supersteps")
-    if (args.engine in ("oracle", "edge", "sharded-edge")
+    if (args.engine not in ("general", "sharded")
             and args.route_cap is not None):
         raise SystemExit(
-            f"--route-cap applies to the general engines only; "
-            f"{args.engine} has no insertion stage to bound")
+            f"--route-cap applies to the XLA general engines only; "
+            f"{args.engine} has no XLA insertion stage to bound "
+            "(fused-sparse bounds its VMEM-resident batch with "
+            "--max-batch; sharded-fused sizes per-shard exchange "
+            "buckets via the API's bucket_cap)")
+    if args.engine not in ("fused-sparse",) \
+            and args.max_batch is not None:
+        raise SystemExit(
+            f"--max-batch sizes the fused-sparse engine's "
+            f"VMEM-resident batch; {args.engine} does not hold one")
     if args.engine == "oracle":
         from .interp.ref.superstep import SuperstepOracle
         return SuperstepOracle(sc, link, seed=args.seed,
@@ -101,19 +110,31 @@ def build_engine(args, sc, link):
         return JaxEngine(sc, link, seed=args.seed, window=args.window,
                          route_cap=args.route_cap,
                          record_events=args.record_events)
+    if args.engine == "fused-sparse":
+        from .interp.jax_engine.fused_sparse import FusedSparseEngine
+        kw = {} if args.max_batch is None else {
+            "max_batch": args.max_batch}
+        return FusedSparseEngine(sc, link, seed=args.seed,
+                                 window=args.window,
+                                 record_events=args.record_events,
+                                 **kw)
     if args.engine == "edge":
         from .interp.jax_engine.edge_engine import EdgeEngine
         return EdgeEngine(sc, link, seed=args.seed, cap=args.edge_cap)
-    if args.engine in ("sharded", "sharded-edge"):
+    if args.engine in ("sharded", "sharded-edge", "sharded-fused"):
         from .interp.jax_engine.sharded import (
-            ShardedEdgeEngine, ShardedEngine, make_mesh)
+            ShardedEdgeEngine, ShardedEngine,
+            ShardedFusedSparseEngine, make_mesh)
         mesh = make_mesh(args.devices)
-        cls = (ShardedEdgeEngine if args.engine == "sharded-edge"
-               else ShardedEngine)
-        if cls is ShardedEdgeEngine:
-            return cls(sc, link, mesh, seed=args.seed, cap=args.edge_cap)
-        return cls(sc, link, mesh, seed=args.seed, window=args.window,
-                   route_cap=args.route_cap)
+        if args.engine == "sharded-edge":
+            return ShardedEdgeEngine(sc, link, mesh, seed=args.seed,
+                                     cap=args.edge_cap)
+        if args.engine == "sharded-fused":
+            return ShardedFusedSparseEngine(
+                sc, link, mesh, seed=args.seed, window=args.window)
+        return ShardedEngine(sc, link, mesh, seed=args.seed,
+                             window=args.window,
+                             route_cap=args.route_cap)
     raise SystemExit(f"unknown engine {args.engine!r}")
 
 
@@ -125,8 +146,9 @@ def main(argv=None) -> int:
     p.add_argument("scenario",
                    choices=["token-ring", "gossip", "praos", "ping-pong"])
     p.add_argument("--engine", default="general",
-                   choices=["oracle", "general", "edge", "sharded",
-                            "sharded-edge"])
+                   choices=["oracle", "general", "fused-sparse",
+                            "edge", "sharded", "sharded-edge",
+                            "sharded-fused"])
     p.add_argument("--nodes", type=int, default=64)
     p.add_argument("--steps", type=int, default=1000,
                    help="max supersteps to run")
@@ -156,6 +178,10 @@ def main(argv=None) -> int:
     p.add_argument("--route-cap", type=int, default=None,
                    help="static active-message budget for the insertion "
                         "stage (clipped messages are counted)")
+    p.add_argument("--max-batch", type=int, default=None,
+                   help="fused-sparse: VMEM-resident message batch "
+                        "bound per superstep (excess counted in "
+                        "route_drop, never silent)")
     p.add_argument("--fanout", type=int, default=8)
     p.add_argument("--slots", type=int, default=10)
     p.add_argument("--leader-prob", type=float, default=0.05)

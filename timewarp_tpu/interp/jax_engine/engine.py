@@ -90,10 +90,13 @@ class EngineState(NamedTuple):
     #: per-event time (fire instant / deliver time) and [kind, node,
     #: src, payload0] columns; ``ev_count`` counts every event ever
     #: produced — entries beyond capacity are dropped, and
-    #: ``ev_count > capacity`` IS the overflow evidence (never silent)
+    #: ``ev_count > capacity`` IS the overflow evidence (never silent).
+    #: int64: a single scalar, and an int32 count would wrap negative
+    #: past ~2.1e9 recorded events, corrupting ring write positions
+    #: (ADVICE r5) — ring *indices* stay int32 (capacity bounds them).
     ev_time: jax.Array     # int64[E]
     ev_meta: jax.Array     # int32[4, E]
-    ev_count: jax.Array    # int32[]
+    ev_count: jax.Array    # int64[]
 
 
 class JaxEngine:
@@ -172,6 +175,13 @@ class JaxEngine:
                 "n_nodes * max_out must fit int32 (sender-major rank)")
         if record_events < 0:
             raise ValueError("record_events must be >= 0")
+        if isinstance(window, str) and window != "auto":
+            # a typo'd "Auto"/"8ms" from a library caller would
+            # otherwise fall through to `window < 1` and raise an
+            # opaque TypeError (ADVICE r5)
+            raise ValueError(
+                f"window must be an int µs count or the string "
+                f"'auto', got {window!r}")
         if window == "auto":
             # widest exact window the link model licenses: every delay
             # is declared >= min_delay_us, so instants within that
@@ -205,6 +215,10 @@ class JaxEngine:
         self.record_events = int(record_events)
         self.s0, self.s1 = seed_words(seed)
         self.comm = LocalComm(scenario.n_nodes)
+        #: subclasses whose routing stage derives mailbox holes while
+        #: the block is already in VMEM (fused_sparse.py) set this to
+        #: skip the [K, N] free-rows sort entirely
+        self._fused_holes = False
 
     # -- initial state ---------------------------------------------------
 
@@ -236,7 +250,7 @@ class JaxEngine:
             time=jnp.int64(0),
             ev_time=jnp.zeros((self.record_events,), jnp.int64),
             ev_meta=jnp.zeros((4, self.record_events), jnp.int32),
-            ev_count=jnp.int32(0),
+            ev_count=jnp.int64(0),
         )
 
     # -- one superstep ---------------------------------------------------
@@ -426,9 +440,12 @@ class JaxEngine:
                     sent_hash = _u32sum(jnp.where(ok_s, sent_mix, 0))
                 else:
                     sent_hash = jnp.uint32(0)
+                # route_drop ≡ 0 here (the top rung is always n); the
+                # slot exists so fused_sparse.py's override can report
+                # its VMEM batch-cap drops through the same call site
                 return (mrel, msrc, mpay, overflow_step, bad_dst_step,
-                        bad_delay_step, short_step, sent_count,
-                        sent_hash)
+                        bad_delay_step, short_step, jnp.int32(0),
+                        sent_count, sent_hash)
             return branch
 
         rungs = self._sender_rungs(n)
@@ -552,13 +569,19 @@ class JaxEngine:
             mb_rel = jnp.where(keep, st.mb_rel - shift32, _I32MAX)
             mb_src = st.mb_src          # stale in holes; validity is the
             mb_payload = st.mb_payload  # rel sentinel, never these
-            #: free_rows[r, i] = row of node i's r-th free slot (K = none)
-            # int8 free-slot table when K fits: 4x less sort
-            # bandwidth AND 4x smaller as a routing-switch operand
-            # (TPU conditionals move their operands)
-            fr_dt = jnp.int8 if K <= 127 else jnp.int32
-            free_rows = jax.lax.sort(
-                jnp.where(keep, K, slots).astype(fr_dt), dimension=0)
+            if self._fused_holes:
+                # the fused-sparse kernel ranks holes in-VMEM per
+                # block — no [K, N] free-slot sort is owed at all
+                free_rows = None
+            else:
+                #: free_rows[r, i] = row of node i's r-th free slot
+                #: (K = none)
+                # int8 free-slot table when K fits: 4x less sort
+                # bandwidth AND 4x smaller as a routing-switch operand
+                # (TPU conditionals move their operands)
+                fr_dt = jnp.int8 if K <= 127 else jnp.int32
+                free_rows = jax.lax.sort(
+                    jnp.where(keep, K, slots).astype(fr_dt), dimension=0)
             counts = None
         else:
             ops2 = jax.lax.sort(
@@ -586,11 +609,11 @@ class JaxEngine:
                     and (W > 1 or M > 1))
         if adaptive:
             (mb_rel, mb_src, mb_payload, overflow_step, bad_dst_step,
-             bad_delay_step, short_step, sent_count, sent_hash) = \
+             bad_delay_step, short_step, route_drop_step, sent_count,
+             sent_hash) = \
                 self._route_adaptive(
                     out, out_valid, now_vec, t, mb_rel, mb_src,
                     mb_payload, free_rows, counts, node_ids, with_trace)
-            route_drop_step = jnp.int32(0)
             return self._finish_superstep(
                 st, live, states, wake, mb_rel, mb_src, mb_payload,
                 deliver, fire, node_ids, t, base, now_vec,
@@ -780,8 +803,13 @@ class JaxEngine:
             # counting (the overflow evidence)
             E = self.record_events
             KN = K * n
+            # ring write positions are int32 (capacity E bounds every
+            # live slot); the int64 running count is clamped to E first
+            # so a >2^31-event run cannot wrap the index arithmetic —
+            # at ev_count >= E every write drops anyway
+            base_i = jnp.minimum(ev_count, jnp.int64(E)).astype(jnp.int32)
             f32 = fire.astype(jnp.int32)
-            pos_f = ev_count + jnp.cumsum(f32, dtype=jnp.int32) - f32
+            pos_f = base_i + jnp.cumsum(f32, dtype=jnp.int32) - f32
             idx_f = jnp.where(fire, pos_f, jnp.int32(E))
             nf = jnp.sum(f32, dtype=jnp.int32)
             ev_time = ev_time.at[idx_f].set(now_vec, mode="drop")
@@ -789,7 +817,7 @@ class JaxEngine:
             ev_meta = ev_meta.at[1, idx_f].set(node_ids, mode="drop")
             dvT = deliver.T.reshape(KN)                  # node-major
             d32 = dvT.astype(jnp.int32)
-            pos_r = ev_count + nf + jnp.cumsum(d32, dtype=jnp.int32) - d32
+            pos_r = base_i + nf + jnp.cumsum(d32, dtype=jnp.int32) - d32
             idx_r = jnp.where(dvT, pos_r, jnp.int32(E))
             dtime = (base + st.mb_rel.astype(jnp.int64)).T.reshape(KN)
             src_r = (st.mb_src if sc.inbox_src

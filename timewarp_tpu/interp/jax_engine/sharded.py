@@ -36,7 +36,8 @@ from .common import group_rank
 from .edge_engine import EdgeEngine, EdgeState
 from .engine import EngineState, JaxEngine
 
-__all__ = ["MeshComm", "ShardedEdgeEngine", "ShardedEngine", "make_mesh"]
+__all__ = ["MeshComm", "ShardedEdgeEngine", "ShardedEngine",
+           "ShardedFusedSparseEngine", "make_mesh"]
 
 
 class ShardedEdgeEngine(ShardedDriver, EdgeEngine):
@@ -170,3 +171,50 @@ class ShardedEngine(ShardedDriver, JaxEngine):
             # (record_events=0 sharded: zero-size, replicated)
             ev_time=P(), ev_meta=P(), ev_count=P(),
         )
+
+
+class ShardedFusedSparseEngine(ShardedEngine):
+    """The multi-chip windowed path's share of the fused-sparse lever
+    (fused_sparse.py): sampling, destination-shard bucketing, and the
+    ``all_to_all`` exchange are :class:`ShardedEngine`'s — message
+    placement is a collective, not a kernel concern — but each shard's
+    post-exchange *mailbox insertion* runs the fused Pallas kernel in
+    its pre-sampled mode: deliver-times arrive with the batch, holes
+    are ranked in-VMEM per block, and the local [K, n_local] mailbox
+    planes stream through the kernel exactly once (no free-rows sort,
+    no per-plane scatters — ``JaxEngine._fused_holes``). Semantics,
+    counters, and trace digests are bit-identical to
+    :class:`ShardedEngine` (tests/test_fused_sparse.py sharded leg)."""
+
+    def __init__(self, scenario: Scenario, link: LinkModel,
+                 mesh: Mesh, *, axis: AxisName = "nodes", seed: int = 0,
+                 bucket_cap: Optional[int] = None,
+                 window: int = 1) -> None:
+        super().__init__(scenario, link, mesh, axis=axis, seed=seed,
+                         bucket_cap=bucket_cap, window=window,
+                         route_cap=None)
+        from .fused_sparse import _build_kernel, _insertion_plan
+        sc = scenario
+        nl = self.comm.n_local
+        # post-exchange batch width: one bucket per peer shard
+        self._S2, R, G = _insertion_plan(
+            sc, nl, self.comm.n_shards * self.bucket_cap,
+            who="ShardedFusedSparseEngine",
+            what_n="n_nodes per shard")
+        self._fused_holes = True
+        self._ins_kernel = _build_kernel(
+            K=sc.mailbox_cap, P=sc.payload_width, R=R, G=G,
+            SR=self._S2 // 128, n=nl, M=sc.max_out, W=self.window,
+            inbox_src=sc.inbox_src, mode="drel", needs_key=False,
+            s0=0, s1=0, delay_fn=None)
+
+    def _insert_sorted(self, mb_rel, mb_src, mb_payload, sd, ok_s,
+                       drel_s, src_s, pay_s, free_rows, counts):
+        from .fused_sparse import _fused_insert_call
+        sc = self.scenario
+        mrel, msrc, mpay, cnts = _fused_insert_call(
+            self._ins_kernel, self._S2, self.comm.n_local,
+            sc.mailbox_cap, sc.payload_width, sc.inbox_src,
+            jnp.zeros(4, jnp.int32), sd, drel_s, src_s, pay_s,
+            mb_rel, mb_src, mb_payload)
+        return mrel, msrc, mpay, jnp.sum(cnts[0], dtype=jnp.int32)

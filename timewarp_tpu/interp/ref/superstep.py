@@ -57,6 +57,13 @@ class SuperstepOracle:
     def __init__(self, scenario: Scenario, link: LinkModel, *,
                  seed: int = 0, record_events: bool = False,
                  window=1) -> None:
+        if isinstance(window, str) and window != "auto":
+            # mirror JaxEngine: a typo'd "Auto"/"8ms" from a library
+            # caller must fail clearly, not as `window < 1`'s opaque
+            # str-vs-int TypeError (ADVICE r5)
+            raise ValueError(
+                f"window must be an int µs count or the string "
+                f"'auto', got {window!r}")
         if window == "auto":    # mirror JaxEngine: link floor = widest
             window = max(1, int(link.min_delay_us))  # exact window
         if window < 1:

@@ -137,11 +137,18 @@ def _jitted_draw(model: "LinkModel"):
         import jax
         import jax.numpy as jnp
 
-        jfn = jax.jit(sample)
-        try:    # trace eagerly so a non-traceable sampler falls back
-            jfn(jnp.uint32(0), jnp.uint32(0), jnp.uint32(0),
-                jnp.uint32(0), jnp.int64(0), jnp.uint32(0))
-            fn = jfn
+        try:
+            # probe traceability on ABSTRACT avals (no concrete
+            # execution): a traceable FnDelay that merely errors on a
+            # degenerate concrete (0, 0, 0) probe input must not be
+            # silently demoted to the eager per-call path for the
+            # whole run (ADVICE r5) — eval_shape only fails when the
+            # sampler genuinely cannot trace (Python control flow on
+            # src/dst/t, host readbacks, ...)
+            u32 = jax.ShapeDtypeStruct((), jnp.uint32)
+            jax.eval_shape(sample, u32, u32, u32, u32,
+                           jax.ShapeDtypeStruct((), jnp.int64), u32)
+            fn = jax.jit(sample)
         except Exception:
             fn = sample
         _DRAW_CACHE[model] = fn

@@ -183,7 +183,19 @@ class Quantize(LinkModel):
     co-temporal batches — the difference between ~10³ and ~10⁷+
     delivered-messages/sec at 100k+ nodes. Deterministic and
     order-preserving: quantization is monotone, so relative arrival
-    order within a link never inverts."""
+    order within a link never inverts.
+
+    **Inner-sample clamp (round 5, changes sampled values):** the
+    inner model's raw delay is clamped to ≥ 1 µs *before* rounding
+    up, so an inner draw of 0 µs yields ``quantum_us`` — not 0 riding
+    the engines' ≥ 1 µs flight clamp. This keeps the declared
+    ``min_delay_us`` (≥ quantum) a true lower bound of the sampled
+    values, which is what gates windowed-superstep validation. For
+    any config/seed whose inner model can emit a raw 0 µs delay
+    (e.g. ``UniformDelay(0, hi)``), delays sampled since round 5
+    differ from earlier rounds, so digests and parity artifacts from
+    before the clamp are not comparable for those configs (README
+    "Compatibility notes")."""
     inner: LinkModel
     quantum_us: int
 
@@ -194,12 +206,9 @@ class Quantize(LinkModel):
     def sample(self, src, dst, t, key):
         d, drop = self.inner.sample(src, dst, t, key)
         q = jnp.int64(self.quantum_us)
-        # clamp BEFORE rounding up: an inner model that samples a raw
-        # 0 µs delay (e.g. UniformDelay(0, hi)) would otherwise
-        # quantize to 0 and ride the engines' >= 1 µs flight clamp,
-        # making the declared min_delay_us (>= quantum) a lie — the
-        # declaration gates windowed-superstep validation, so it must
-        # be a true lower bound of the sampled values
+        # clamp BEFORE rounding up (class docstring: keeps
+        # min_delay_us a true lower bound; changes digests for
+        # inner models that can emit a raw 0)
         d = jnp.maximum(d, jnp.int64(1))
         return ((d + q - 1) // q) * q, drop
 

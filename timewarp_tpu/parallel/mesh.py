@@ -30,6 +30,28 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..interp.jax_engine.common import LocalComm
 
+try:  # newer jax exports shard_map at the top level
+    _shard_map = jax.shard_map
+except AttributeError:  # 0.4.x: experimental home
+    from jax.experimental.shard_map import shard_map as _shard_map
+# the replication-check kwarg was renamed check_rep -> check_vma in a
+# DIFFERENT release than the public promotion — read the signature
+# instead of inferring from where shard_map lives
+import inspect as _inspect
+
+_CHECK_KW = ("check_vma" if "check_vma"
+             in _inspect.signature(_shard_map).parameters
+             else "check_rep")
+
+
+def _smap(f, mesh, in_specs, out_specs):
+    """Version-portable ``shard_map`` with replication checking off
+    (the engines' collectives are hand-placed; the checker rejects the
+    boundary-slice ppermute pattern on some jax versions)."""
+    return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, **{_CHECK_KW: False})
+
+
 __all__ = ["AxisName", "Mesh", "MeshComm", "ShardedDriver", "axis_size",
            "make_mesh"]
 
@@ -165,9 +187,7 @@ class ShardedDriver:
                 return self._superstep(carry, True)
             return jax.lax.scan(step, s, None, length=max_steps)
 
-        return jax.shard_map(
-            body, mesh=self.mesh, in_specs=(specs,),
-            out_specs=(specs, P()), check_vma=False)(st)
+        return _smap(body, self.mesh, (specs,), (specs, P()))(st)
 
     @partial(jax.jit, static_argnums=(0,))
     def _run_while(self, st, max_steps):
@@ -188,6 +208,5 @@ class ShardedDriver:
 
             return jax.lax.while_loop(cond, body, s)
 
-        return jax.shard_map(
-            body_fn, mesh=self.mesh, in_specs=(specs, P()),
-            out_specs=specs, check_vma=False)(st, max_steps)
+        return _smap(body_fn, self.mesh, (specs, P()),
+                     specs)(st, max_steps)

@@ -23,7 +23,8 @@ from typing import List, Optional
 
 import numpy as np
 
-__all__ = ["SuperstepTrace", "TraceMismatch", "assert_traces_equal"]
+__all__ = ["SuperstepTrace", "TraceMismatch", "assert_states_equal",
+           "assert_traces_equal"]
 
 _FIELDS = ("times", "fired_count", "fired_hash", "recv_count", "recv_hash",
            "sent_count", "sent_hash", "overflow")
@@ -81,3 +82,25 @@ def assert_traces_equal(a: SuperstepTrace, b: SuperstepTrace,
         raise TraceMismatch(
             f"trace lengths differ: {a_name}={len(a)} {b_name}={len(b)}"
             f" (first {n} supersteps agree)")
+
+
+def assert_states_equal(a, b, tag: str = "") -> None:
+    """Bit-for-bit EngineState (or any NamedTuple-of-arrays pytree
+    whose ``states`` field is a dict of arrays) comparison — the
+    exactness law the fused engines are held to against the XLA
+    general engine (tests/test_fused_sparse.py, the in-bench gates).
+    One copy, so every caller asserts the same law."""
+    import jax
+    suffix = f" ({tag})" if tag else ""
+    for name in a._fields:
+        x, y = getattr(a, name), getattr(b, name)
+        if name == "states":
+            for leaf in x:
+                if not np.array_equal(
+                        np.asarray(jax.device_get(x[leaf])),
+                        np.asarray(jax.device_get(y[leaf]))):
+                    raise TraceMismatch(
+                        f"state.{leaf} diverged{suffix}")
+        elif not np.array_equal(np.asarray(jax.device_get(x)),
+                                np.asarray(jax.device_get(y))):
+            raise TraceMismatch(f"{name} diverged{suffix}")

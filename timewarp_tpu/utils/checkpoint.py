@@ -15,8 +15,20 @@ Format compatibility: checkpoints are tied to the engine-state pytree
 of the code that wrote them; a state-layout change (e.g. round 3
 removing the derived mb_valid/q_valid leaves) makes older .npz files
 fail loudly at load ("checkpoint has N leaves / tree structure does
-not match") rather than resume wrong state. There is no silent
-migration — re-run from the scenario start or an on-format checkpoint.
+not match") rather than resume wrong state. There is no lossy or
+structural migration — re-run from the scenario start or an on-format
+checkpoint. The one sanctioned conversion is the **lossless int32 →
+int64 widening** of a same-shape leaf (round 6: ``EngineState.
+ev_count`` widened so event counts past ~2.1e9 cannot wrap — README
+"Compatibility notes"); every int32 value is exactly representable in
+int64, so a pre-widening checkpoint resumes bit-identically.
+
+Engine interchange needs no conversion here: the fused-sparse engine
+(interp/jax_engine/fused_sparse.py) shares ``EngineState`` bit-for-bit
+with ``JaxEngine``, so a checkpoint saved under either resumes under
+the other (tests/test_fused_sparse.py) — unlike the fused *ring*
+engine, whose packed layout needs its own ``to_edge_state`` /
+``from_edge_state`` pair (fused_ring.py).
 """
 
 from __future__ import annotations
@@ -69,6 +81,13 @@ def load_state(path: str, like: Any, *, expect_meta: dict = None):
             f"  saved:    {saved_treedef}\n  template: {treedef}")
     for i, (got, want) in enumerate(zip(leaves, t_leaves)):
         w = np.asarray(want)
+        if (got.shape == w.shape and got.dtype == np.int32
+                and w.dtype == np.int64):
+            # the sanctioned lossless widening (module docstring):
+            # a leaf the state layout grew from int32 to int64 —
+            # ev_count, round 6 — resumes exactly from an old file
+            leaves[i] = got.astype(np.int64)
+            continue
         if got.shape != w.shape or got.dtype != w.dtype:
             raise ValueError(
                 f"checkpoint leaf {i}: {got.shape}/{got.dtype} does not "

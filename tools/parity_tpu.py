@@ -9,9 +9,18 @@ models, so equality is exact across backends (core/rng.py, SURVEY.md
 
 Configs: ping-pong (BASELINE config 1), token-ring 64 fixed-latency
 (config 2, edge engine), token-ring 64 w/ observer + uniform links
-(general engine), gossip-64 w/ drops, plus the round-4 execution modes:
+(general engine), gossip-64 w/ drops, the round-4 execution modes:
 burst-gossip under a multi-instant window and burst-praos under a
-window with route_cap (all integer link models).
+window with route_cap (all integer link models), plus — round 6 —
+socket-state (BASELINE config 3's batched twin, models/socket_state.py)
+at the baseline shape and at the 1024-node windowed hub-fan-in shape.
+
+Every config also carries a **fused-sparse column**: the
+FusedSparseEngine (interp/jax_engine/fused_sparse.py) is constructed
+with the same knobs and its trace compared bit-for-bit against the
+general engine's. Configs outside the fused engine's scope (non-1024
+node counts, droppy links, route_cap, ...) record the constructor's
+refusal reason instead — the column is never silently absent.
 
 Usage: ``python tools/parity_tpu.py`` (writes PARITY_TPU.json at the
 repo root). Exits nonzero on any trace mismatch. If no accelerator is
@@ -46,10 +55,13 @@ def trace_sha(tr) -> str:
 def main() -> int:
     from timewarp_tpu.interp.jax_engine.edge_engine import EdgeEngine
     from timewarp_tpu.interp.jax_engine.engine import JaxEngine
+    from timewarp_tpu.interp.jax_engine.fused_sparse import \
+        FusedSparseEngine
     from timewarp_tpu.interp.ref.superstep import SuperstepOracle
     from timewarp_tpu.models.gossip import gossip
     from timewarp_tpu.models.ping_pong import ping_pong
     from timewarp_tpu.models.praos import praos
+    from timewarp_tpu.models.socket_state import socket_state
     from timewarp_tpu.models.token_ring import token_ring, token_ring_links
     from timewarp_tpu.net.delays import (
         FixedDelay, Quantize, UniformDelay, WithDrop)
@@ -86,6 +98,30 @@ def main() -> int:
             praos(48, slot_us=20_000, n_slots=6, leader_prob=2.0 / 48,
                   fanout=4, burst=True, mailbox_cap=16),
             wlink, JaxEngine, 600, {"window": 3_000, "route_cap": 96}),
+        # round 6: BASELINE config 3's batched twin — the per-socket
+        # user-state example, value-stream-tied to the net world in
+        # tests/test_cross_world_socket_state.py; here it holds the
+        # same bit-exact oracle ≡ engine law as every other config
+        # (the deadline shape: the listener stop-gate actually bites)
+        "socket-state-4": (
+            socket_state(n_clients=3, seed=24, send_interval_us=50_000,
+                         server_life_us=120_000),
+            wlink, JaxEngine, 400, {}),
+        # the 1024-node windowed hub-fan-in shape: the fused-sparse
+        # engine's scope floor (1024-lane mailbox planes), and the
+        # hard regime for its hole accounting — a 1023-way
+        # co-temporal fan-in overflowing the hub mailbox
+        "socket-state-1024-windowed": (
+            socket_state(n_clients=1023, seed=1,
+                         send_interval_us=20_000,
+                         server_life_us=2_000_000, mailbox_cap=64),
+            wlink, JaxEngine, 250, {"window": 3_000}),
+        # the fused engine's bench shape family at artifact scale:
+        # burst gossip at 1024 nodes under the 3 ms window
+        "gossip-1024-burst-windowed": (
+            gossip(1024, fanout=4, think_us=700, burst=True,
+                   end_us=400_000, mailbox_cap=16),
+            wlink, JaxEngine, 600, {"window": 3_000}),
     }
 
     out = {"engine_platform": platform, "oracle_platform": "cpu",
@@ -117,10 +153,42 @@ def main() -> int:
             entry["equal"] = False
             entry["mismatch"] = str(e)
             out["ok"] = False
+
+        # fused-sparse column (round 6): same knobs — except
+        # route_cap, the XLA insertion stage's capacity contract; the
+        # fused engine bounds its VMEM-resident batch with max_batch
+        # (default: no superstep here can drop) — trace bit-for-bit
+        # against the general engine. Out-of-scope configs record the
+        # constructor's refusal, never a silent absence.
+        fkw = {k: v for k, v in ekw.items() if k != "route_cap"}
+        try:
+            fused = FusedSparseEngine(sc, link, **fkw)
+        except ValueError as e:
+            entry["fused_sparse"] = {
+                "supported": False,
+                "reason": str(e).split(" (")[0]}
+        else:
+            _, ftrace = fused.run(steps)
+            fent = {"supported": True, "sha": trace_sha(ftrace)}
+            try:
+                assert_traces_equal(etrace, ftrace,
+                                    f"general-{platform}",
+                                    f"fused-sparse-{platform}")
+                fent["equal"] = True
+            except TraceMismatch as e:
+                fent["equal"] = False
+                fent["mismatch"] = str(e)
+                out["ok"] = False
+            entry["fused_sparse"] = fent
+
         out["configs"][name] = entry
+        fus = entry["fused_sparse"]
+        fused_word = ("fused-sparse out of scope" if not fus["supported"]
+                      else "fused-sparse "
+                      + ("OK" if fus["equal"] else "MISMATCH"))
         print(f"{name}: {'OK' if entry['equal'] else 'MISMATCH'} "
               f"({entry['supersteps']} supersteps, "
-              f"{entry['delivered']} delivered)")
+              f"{entry['delivered']} delivered, {fused_word})")
 
     if "--self-check" not in sys.argv:
         root = os.path.dirname(os.path.dirname(os.path.abspath(
