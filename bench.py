@@ -369,12 +369,29 @@ def _calibrate():
     return {"kernel": "sort_1m_int32_x64", "seconds": round(dt, 4)}
 
 
+def _lint_gate() -> None:
+    """Scenario sanitizer sweep (timewarp_tpu.analysis) over every
+    shipped model and program twin before any config runs: a bench
+    number for a contract-violating scenario is a number about
+    nothing. Same sweep as CI's `lint` job — but silent on success,
+    so the bench contract (one JSON line per config/run on stdout)
+    holds."""
+    from timewarp_tpu.cli import lint_sweep
+    _, report = lint_sweep()
+    if not report.ok:
+        sys.stderr.write(report.render() + "\n")
+        raise SystemExit(
+            "bench: error-severity lint findings in shipped models "
+            "(run `timewarp-tpu lint` for the report)")
+
+
 def smoke() -> None:
     """CI fast path: every config at its SMOKE shape, exactness gates
     on, one JSON line each. Throughput numbers at smoke scale are
     meaningless and marked so — the value of this mode is that a
     kernel-vs-engine divergence or a broken parity-regime invariant
     raises before a full bench round ever runs."""
+    _lint_gate()
     for cfg, (n, steps) in SMOKE.items():
         t0 = time.perf_counter()
         metric, _ = CONFIGS[cfg](n, steps)
@@ -388,6 +405,7 @@ def main() -> None:
     if "--smoke" in sys.argv:
         smoke()
         return
+    _lint_gate()
     cfg = os.environ.get("TW_BENCH_CONFIG", "token_ring_dense")
     n = int(os.environ.get("TW_BENCH_NODES", 0)) or None
     steps = int(os.environ.get("TW_BENCH_STEPS", 0)) or None

@@ -106,3 +106,48 @@ def test_cli_oracle_rejects_checkpoint_flags(tmp_path):
     with pytest.raises(SystemExit):
         main(["token-ring", "--engine", "oracle",
               "--save", str(tmp_path / "x.npz")])
+
+
+def test_parse_link_malformed_specs_name_the_grammar():
+    # these used to die with a raw IndexError / ValueError
+    for bad in ("uniform:5", "fixed:x", "lognormal:1000",
+                "drop:0.1", "quantize:5", "fixed:1:2",
+                "uniform:1:2:3", "drop:x:fixed:5"):
+        with pytest.raises(SystemExit) as ei:
+            parse_link(bad)
+        assert "grammar" in str(ei.value), bad
+    with pytest.raises(SystemExit) as ei:
+        parse_link("bogus:1")
+    assert "grammar" in str(ei.value)
+    # a malformed INNER spec of a wrapper also names the grammar
+    with pytest.raises(SystemExit) as ei:
+        parse_link("drop:0.5:uniform:7")
+    assert "grammar" in str(ei.value)
+
+
+def test_cli_lint_subcommand_all_models_clean(capsys):
+    # the CI gate: every shipped model + program twin, zero errors
+    assert main(["lint", "--json", "--nodes", "32", "--no-probe"]) == 0
+    rep = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rep["errors"] == 0
+    assert rep["subjects"] >= 14
+
+
+def test_cli_lint_subcommand_family_filter_with_probe(capsys):
+    assert main(["lint", "gossip", "--json", "--nodes", "32"]) == 0
+    rep = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rep["errors"] == 0 and rep["subjects"] == 4
+
+
+def test_cli_lint_subcommand_rejects_unknown_family():
+    with pytest.raises(SystemExit):
+        main(["lint", "no-such-scenario"])
+
+
+def test_cli_lint_flag_modes_run_identically(capsys):
+    common = ["token-ring", "--nodes", "16", "--steps", "80",
+              "--think-us", "10000", "--link", "fixed:2000"]
+    base = run_cli(capsys, *common)
+    for mode in ("warn", "error", "off"):
+        r = run_cli(capsys, *common, "--lint", mode)
+        assert r == base        # lint never changes run behavior

@@ -1,0 +1,83 @@
+"""core/time.py edge cases: fractional-unit rounding, back-in-time
+clamping in ``resolve``, FOREVER arithmetic headroom, and the
+documented zero-arg ``for_()`` error contract."""
+
+import pytest
+
+from timewarp_tpu.core.scenario import NEVER
+from timewarp_tpu.core.time import (FOREVER, after, at, for_, hour, mcs,
+                                    minute, ms, now, resolve, sec, till)
+
+
+# -- fractional units round (MonadTimed.hs:261-266 semantics) ------------
+
+def test_fractional_units_round_to_int_microseconds():
+    assert ms(1.5) == 1_500
+    assert sec(0.25) == 250_000
+    assert sec(2.5) == 2_500_000
+    assert minute(0.5) == 30_000_000
+    assert hour(0.001) == 3_600_000
+    assert mcs(1.4) == 1
+    assert mcs(1.6) == 2
+    # results are plain python ints (the int64-µs contract)
+    for v in (ms(1.5), sec(0.25), minute(0.5), hour(0.001), mcs(1.4)):
+        assert type(v) is int
+
+
+def test_integral_units_are_exact():
+    assert mcs(7) == 7
+    assert ms(3) == 3_000
+    assert sec(3) == 3_000_000
+    assert minute(2) == 120_000_000
+    assert hour(1) == 3_600_000_000
+
+
+# -- resolve: never travels back in time (TimedT.hs:349 clamp) -----------
+
+def test_resolve_clamps_absolute_specs_in_the_past():
+    assert resolve(till(5), 100) == 100
+    assert resolve(at(99), 100) == 100
+    assert resolve(till(100), 100) == 100      # exactly now is legal
+    assert resolve(till(101), 100) == 101
+
+
+def test_resolve_clamps_negative_relative_durations():
+    assert resolve(-50, 100) == 100            # bare negative duration
+    assert resolve(for_(-50), 100) == 100
+    assert resolve(0, 100) == 100
+    assert resolve(25, 100) == 125             # bare duration = relative
+
+
+def test_resolve_identity_spec():
+    assert resolve(now, 1234) == 1234
+
+
+def test_variadic_accumulators():
+    # ``for 1 minute 30 sec`` (MonadTimed.hs:351-376)
+    assert for_(minute(1), sec(30))(0) == 90_000_000
+    assert after(sec(1), ms(500), mcs(1))(10) == 10 + 1_500_001
+    assert till(sec(1), sec(2))(999) == 3_000_000
+
+
+# -- FOREVER headroom: sums never overflow int64 -------------------------
+
+def test_forever_arithmetic_headroom():
+    assert NEVER == FOREVER == (1 << 62) - 1
+    # the docstring's claim, exactly: a sum of two sentinels fits int64
+    assert FOREVER + FOREVER < 2**63
+    assert resolve(for_(FOREVER), FOREVER) == 2 * FOREVER
+    # a relative spec against a FOREVER clock stays representable
+    assert resolve(after(sec(1)), FOREVER) == FOREVER + 1_000_000
+
+
+# -- zero-arg for_() is a bug, not a zero wait ---------------------------
+
+def test_zero_arg_for_is_an_error():
+    with pytest.raises(TypeError):
+        for_()
+    with pytest.raises(TypeError):
+        after()
+    with pytest.raises(TypeError):
+        till()
+    # the documented way to fire "now-ish": an explicit zero duration
+    assert resolve(for_(0), 42) == 42

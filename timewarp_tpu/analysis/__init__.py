@@ -1,0 +1,102 @@
+"""Scenario sanitizer: static analysis for both authoring styles.
+
+The framework's determinism contract (core/scenario.py:28-47) is
+checkable *before* any engine run:
+
+- :mod:`.jaxpr_lint` — abstract-traces ``Scenario.step`` and checks
+  host-escape primitives, time-dtype discipline, outbox conformance
+  and the declared-flag dataflow (TW1xx).
+- :mod:`.capacity` — static mailbox-capacity proofs over
+  ``static_dst`` topologies; reported bounds for dynamic ones (TW2xx).
+- :mod:`.program_lint` — AST lints for generator effect programs:
+  dropped combinator calls, host IO in pure contexts, swallowed
+  ``ThreadKilled`` (TW3xx).
+- :mod:`.probes` — seeded permutation probe for ``commutative_inbox``,
+  the one flag dataflow cannot verify (TW4xx).
+
+Every engine runs :func:`check_scenario` at construction under its
+``lint="error"|"warn"|"off"`` knob (default ``"warn"``); the CLI
+exposes ``timewarp-tpu lint`` over every shipped model and a
+``--lint`` flag on runs. See docs/authoring.md "Lint rules" for the
+full rule table and suppression mechanics.
+"""
+
+from __future__ import annotations
+
+import logging
+
+from ..core.scenario import Scenario
+from .capacity import lint_capacity, worst_case_fan_in
+from .jaxpr_lint import HOST_ESCAPE_PRIMITIVES, lint_step_jaxpr
+from .probes import probe_commutative_inbox
+from .program_lint import (GENERATOR_COMBINATORS, lint_module_programs,
+                           lint_program, lint_source)
+from .report import (ERROR, INFO, WARNING, Finding, LintError,
+                     LintReport)
+
+__all__ = [
+    "Finding", "LintReport", "LintError",
+    "ERROR", "WARNING", "INFO",
+    "lint_scenario", "check_scenario", "LINT_MODES",
+    "lint_step_jaxpr", "lint_capacity", "worst_case_fan_in",
+    "probe_commutative_inbox",
+    "lint_program", "lint_source", "lint_module_programs",
+    "HOST_ESCAPE_PRIMITIVES", "GENERATOR_COMBINATORS",
+]
+
+log = logging.getLogger("timewarp_tpu.analysis")
+
+#: valid values of the engines' construction-lint knob
+LINT_MODES = ("error", "warn", "off")
+
+
+def lint_scenario(scenario: Scenario, *, probe: bool = False,
+                  seed: int = 0) -> LintReport:
+    """Run every scenario-level checker. ``probe=True`` adds the
+    concrete ``commutative_inbox`` permutation probe (executes the step
+    a handful of times — engines skip it at construction; the CLI
+    ``lint`` subcommand runs it by default).
+
+    Findings whose code appears in ``scenario.meta["lint_ignore"]``
+    are suppressed (the documented opt-out, docs/authoring.md)."""
+    rep = LintReport()
+    rep.extend(lint_step_jaxpr(scenario))
+    rep.extend(lint_capacity(scenario))
+    if probe:
+        rep.extend(probe_commutative_inbox(scenario, seed=seed))
+    ignore = ()
+    if isinstance(scenario.meta, dict):
+        ignore = tuple(scenario.meta.get("lint_ignore", ()))
+    return rep.filtered(ignore) if ignore else rep
+
+
+def check_scenario(scenario: Scenario, mode: str, *,
+                   who: str = "engine"):
+    """Construction-time hook shared by every engine.
+
+    ``mode="off"`` returns None without looking at the scenario (the
+    bit-for-bit compatibility path). ``"error"`` raises
+    :class:`LintError` on any error-severity finding. ``"warn"`` (the
+    default everywhere) logs errors at WARNING and perf findings at
+    INFO, then lets construction proceed. The (probe-free) report is
+    cached on the scenario object — engines are constructed far more
+    often than scenarios are built."""
+    if mode == "off":
+        return None
+    if mode not in LINT_MODES:
+        raise ValueError(
+            f"lint must be one of {LINT_MODES}, got {mode!r}")
+    report = getattr(scenario, "_lint_cache", None)
+    if report is None:
+        report = lint_scenario(scenario, probe=False)
+        try:
+            scenario._lint_cache = report
+        except Exception:  # noqa: BLE001 — cache is best-effort
+            pass
+    if mode == "error" and not report.ok:
+        raise LintError(report, who=who)
+    for f in report.errors:
+        log.warning("%s: %s", who, f.render())
+    for f in report.warnings:
+        log.info("%s: %s", who, f.render())
+    return report

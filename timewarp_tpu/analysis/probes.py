@@ -1,0 +1,129 @@
+"""Seeded permutation probe for the ``commutative_inbox`` flag.
+
+``commutative_inbox=True`` is the one declaration pure jaxpr dataflow
+cannot validate: whether a step's *result* is invariant to inbox slot
+order is a semantic property, not a structural one. The engines lean
+hard on the flag — they skip the contract-#2 inbox sort, present
+delivered slots in raw mailbox-row order (engine.py step 3), and turn
+freed slots into unsorted holes — so a falsely-declared flag produces
+engine-vs-oracle digest divergence with no local symptom.
+
+The probe checks the property the cheap way: execute the step
+concretely on a handful of nodes with randomized inboxes and compare
+the full result (state', outbox, next_wake) bit-for-bit across seeded
+slot permutations. Invalid slots carry the canonical padding every
+interpreter presents (src 0, time NEVER, payload 0 — engine.py step 3
+/ superstep.py), and the padding permutes *with* the slots, exactly
+the variation the engine's raw-mailbox-order inbox exhibits. A probe
+is evidence, not proof — but three rounds × three permutations ×
+several nodes catches every first-slot / positional dependence, which
+is the realistic bug class.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..utils import jaxconfig  # noqa: F401
+
+import jax
+import jax.numpy as jnp
+
+from ..core.rng import fire_bits, seed_words
+from ..core.scenario import NEVER, Inbox, Scenario
+from .report import ERROR, WARNING, Finding, LintReport
+
+__all__ = ["probe_commutative_inbox"]
+
+
+def _tree_equal(a, b) -> bool:
+    la, ta = jax.tree.flatten(a)
+    lb, tb = jax.tree.flatten(b)
+    if ta != tb:
+        return False
+    for x, y in zip(la, lb):
+        x, y = np.asarray(x), np.asarray(y)
+        if x.dtype != y.dtype or x.shape != y.shape \
+                or not np.array_equal(x, y):
+            return False
+    return True
+
+
+def probe_commutative_inbox(sc: Scenario, *, seed: int = 0,
+                            rounds: int = 3, max_nodes: int = 4,
+                            now_us: int = 1_000_000) -> LintReport:
+    """Empty report when the scenario does not declare
+    ``commutative_inbox``; otherwise TW401 errors for every node whose
+    step result changed under an inbox slot permutation."""
+    rep = LintReport()
+    if not sc.commutative_inbox:
+        return rep
+    name = sc.name
+    K, P, n = sc.mailbox_cap, sc.payload_width, sc.n_nodes
+    rng = np.random.default_rng(seed)
+    s0, s1 = seed_words(seed)
+    nodes = list(range(min(n, max_nodes)))
+
+    try:
+        states = [jax.tree.map(jnp.asarray, sc.init(i)[0]) for i in nodes]
+    except Exception as e:  # noqa: BLE001 — lint must not crash callers
+        rep.add(Finding("TW400", WARNING, name,
+                        f"commutative-inbox probe skipped: init "
+                        f"failed ({e!r})"))
+        return rep
+
+    now = jnp.int64(now_us)
+    for r in range(rounds):
+        # a partially-filled inbox with distinct times/srcs/payloads —
+        # distinctness maximizes the chance an order dependence shows
+        # (K == 1 still probes: the one valid slot moves among padding)
+        n_valid = 1 if K == 1 else int(rng.integers(2, K + 1))
+        valid = np.zeros(K, bool)
+        valid[:n_valid] = True
+        times = np.full(K, NEVER, np.int64)
+        times[:n_valid] = np.sort(
+            rng.choice(np.arange(1, now_us, dtype=np.int64),
+                       size=n_valid, replace=False))
+        srcs = np.zeros(K, np.int32)
+        srcs[:n_valid] = rng.integers(0, n, size=n_valid)
+        pay = np.zeros((K, P), np.int32)
+        pay[:n_valid] = rng.integers(0, 8, size=(n_valid, P))
+        if not sc.inbox_src:
+            srcs[:] = 0         # the engines elide src for this flag
+        perms = [np.arange(K)] + [rng.permutation(K) for _ in range(2)]
+
+        for node, state in zip(nodes, states):
+            nid = jnp.int32(node)
+            key = None
+            if sc.needs_key:
+                key = fire_bits(s0, s1, nid, now)
+            ref = None
+            for p_i, perm in enumerate(perms):
+                inbox = Inbox(
+                    valid=jnp.asarray(valid[perm]),
+                    src=jnp.asarray(srcs[perm]),
+                    time=jnp.asarray(times[perm]),
+                    payload=jnp.asarray(pay[perm]),
+                )
+                try:
+                    got = sc.step(state, inbox, now, nid, key)
+                except Exception as e:  # noqa: BLE001
+                    rep.add(Finding(
+                        "TW400", WARNING, name,
+                        f"commutative-inbox probe skipped: step failed "
+                        f"on a probe inbox ({e!r})"))
+                    return rep
+                if ref is None:
+                    ref = got
+                elif not _tree_equal(ref, got):
+                    rep.add(Finding(
+                        "TW401", ERROR, name,
+                        "commutative_inbox=True but the step result "
+                        f"depends on inbox slot order (node {node}, "
+                        f"probe round {r}, permutation {p_i}, seed "
+                        f"{seed}): engines skip the contract-#2 inbox "
+                        "sort for this flag, so this diverges from "
+                        "the oracle. Declare commutative_inbox=False "
+                        "or make the step a commutative reduction"))
+                    return rep
+    return rep

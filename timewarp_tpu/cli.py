@@ -30,24 +30,56 @@ def _window_arg(s: str):
     return "auto" if s == "auto" else int(s)
 
 
+#: the --link grammar, named in every parse error
+LINK_GRAMMAR = ("fixed:D | uniform:LO:HI | lognormal:MEDIAN:SIGMA | "
+                "drop:P:<inner> | quantize:Q:<inner>  "
+                "(D/LO/HI/MEDIAN/Q integer µs; P/SIGMA float)")
+
+
 def parse_link(spec: str):
     """``fixed:D`` | ``uniform:LO:HI`` | ``lognormal:MEDIAN:SIGMA`` —
-    optionally wrapped ``drop:P:<inner>`` and/or ``quantize:Q:<inner>``."""
+    optionally wrapped ``drop:P:<inner>`` and/or ``quantize:Q:<inner>``.
+    Malformed specs die with a message naming the grammar, never a raw
+    IndexError/ValueError."""
     from .net.delays import (FixedDelay, LogNormalDelay, Quantize,
                              UniformDelay, WithDrop)
     parts = spec.split(":")
     kind = parts[0]
-    if kind == "drop":
-        return WithDrop(parse_link(":".join(parts[2:])), float(parts[1]))
-    if kind == "quantize":
-        return Quantize(parse_link(":".join(parts[2:])), int(parts[1]))
-    if kind == "fixed":
-        return FixedDelay(int(parts[1]))
-    if kind == "uniform":
-        return UniformDelay(int(parts[1]), int(parts[2]))
-    if kind == "lognormal":
-        return LogNormalDelay(int(parts[1]), float(parts[2]))
-    raise SystemExit(f"unknown link spec {spec!r}")
+    try:
+        if kind == "drop":
+            if len(parts) < 3 or not parts[2]:
+                raise ValueError("drop needs a probability and an "
+                                 "inner spec")
+            return WithDrop(parse_link(":".join(parts[2:])),
+                            float(parts[1]))
+        if kind == "quantize":
+            if len(parts) < 3 or not parts[2]:
+                raise ValueError("quantize needs a grid and an "
+                                 "inner spec")
+            return Quantize(parse_link(":".join(parts[2:])),
+                            int(parts[1]))
+        if kind == "fixed":
+            if len(parts) != 2:
+                raise ValueError("fixed takes exactly one delay")
+            return FixedDelay(int(parts[1]))
+        if kind == "uniform":
+            if len(parts) != 3:
+                raise ValueError("uniform takes exactly LO and HI")
+            return UniformDelay(int(parts[1]), int(parts[2]))
+        if kind == "lognormal":
+            if len(parts) != 3:
+                raise ValueError("lognormal takes exactly MEDIAN "
+                                 "and SIGMA")
+            return LogNormalDelay(int(parts[1]), float(parts[2]))
+    except SystemExit:
+        raise                   # an inner spec already produced the
+    except (IndexError, ValueError) as e:        # grammar-named error
+        raise SystemExit(
+            f"malformed link spec {spec!r} ({e}); "
+            f"grammar: {LINK_GRAMMAR}") from None
+    raise SystemExit(
+        f"unknown link spec kind {kind!r} in {spec!r}; "
+        f"grammar: {LINK_GRAMMAR}")
 
 
 def build_scenario(args):
@@ -104,12 +136,13 @@ def build_engine(args, sc, link):
     if args.engine == "oracle":
         from .interp.ref.superstep import SuperstepOracle
         return SuperstepOracle(sc, link, seed=args.seed,
-                               window=args.window)
+                               window=args.window, lint=args.lint)
     if args.engine == "general":
         from .interp.jax_engine.engine import JaxEngine
         return JaxEngine(sc, link, seed=args.seed, window=args.window,
                          route_cap=args.route_cap,
-                         record_events=args.record_events)
+                         record_events=args.record_events,
+                         lint=args.lint)
     if args.engine == "fused-sparse":
         from .interp.jax_engine.fused_sparse import FusedSparseEngine
         kw = {} if args.max_batch is None else {
@@ -117,10 +150,11 @@ def build_engine(args, sc, link):
         return FusedSparseEngine(sc, link, seed=args.seed,
                                  window=args.window,
                                  record_events=args.record_events,
-                                 **kw)
+                                 lint=args.lint, **kw)
     if args.engine == "edge":
         from .interp.jax_engine.edge_engine import EdgeEngine
-        return EdgeEngine(sc, link, seed=args.seed, cap=args.edge_cap)
+        return EdgeEngine(sc, link, seed=args.seed, cap=args.edge_cap,
+                          lint=args.lint)
     if args.engine in ("sharded", "sharded-edge", "sharded-fused"):
         from .interp.jax_engine.sharded import (
             ShardedEdgeEngine, ShardedEngine,
@@ -128,17 +162,143 @@ def build_engine(args, sc, link):
         mesh = make_mesh(args.devices)
         if args.engine == "sharded-edge":
             return ShardedEdgeEngine(sc, link, mesh, seed=args.seed,
-                                     cap=args.edge_cap)
+                                     cap=args.edge_cap,
+                                     lint=args.lint)
         if args.engine == "sharded-fused":
             return ShardedFusedSparseEngine(
-                sc, link, mesh, seed=args.seed, window=args.window)
+                sc, link, mesh, seed=args.seed, window=args.window,
+                lint=args.lint)
         return ShardedEngine(sc, link, mesh, seed=args.seed,
                              window=args.window,
-                             route_cap=args.route_cap)
+                             route_cap=args.route_cap,
+                             lint=args.lint)
     raise SystemExit(f"unknown engine {args.engine!r}")
 
 
+def lint_targets(families=None, *, nodes: int = 64):
+    """Every shipped model the ``lint`` subcommand sweeps: state-machine
+    scenarios as builder thunks (so one bad build does not kill the
+    sweep) and the effect-program ``_net`` twin modules. ``families``
+    filters by scenario family name."""
+    scenarios = {
+        "token-ring": [
+            lambda: _m("token_ring").token_ring(nodes),
+            lambda: _m("token_ring").token_ring(nodes,
+                                                with_observer=False),
+        ],
+        "gossip": [
+            lambda: _m("gossip").gossip(nodes),
+            lambda: _m("gossip").gossip(nodes, burst=True),
+            lambda: _m("gossip").gossip(nodes, steady=True),
+        ],
+        "praos": [
+            lambda: _m("praos").praos(nodes),
+            lambda: _m("praos").praos(nodes, burst=True),
+        ],
+        "ping-pong": [lambda: _m("ping_pong").ping_pong()],
+        "socket-state": [
+            lambda: _m("socket_state").socket_state(min(nodes, 16))],
+    }
+    modules = {
+        "token-ring": ["token_ring_net"],
+        "gossip": ["gossip_net"],
+        "praos": ["praos_net"],
+        "ping-pong": ["ping_pong_net"],
+        "socket-state": ["socket_state_net"],
+    }
+    if families:
+        unknown = set(families) - set(scenarios)
+        if unknown:
+            raise SystemExit(
+                f"unknown scenario families {sorted(unknown)}; "
+                f"choose from {sorted(scenarios)}")
+        scenarios = {k: v for k, v in scenarios.items() if k in families}
+        modules = {k: v for k, v in modules.items() if k in families}
+    return scenarios, modules
+
+
+def _m(name):
+    import importlib
+    return importlib.import_module(f"timewarp_tpu.models.{name}")
+
+
+def lint_sweep(families=None, *, nodes: int = 64, probe: bool = True,
+               seed: int = 0):
+    """The shared sanitizer sweep behind both ``timewarp-tpu lint``
+    and bench's pre-run gate: returns ``(subjects, LintReport)``. A
+    subject that fails to build or import becomes a TW000 error
+    finding — one broken model never kills the sweep."""
+    from .analysis import (ERROR, Finding, LintReport,
+                           lint_module_programs, lint_scenario)
+    scenarios, modules = lint_targets(families, nodes=nodes)
+    report = LintReport()
+    subjects = 0
+    for fam, builders in scenarios.items():
+        for build in builders:
+            subjects += 1
+            try:
+                sc = build()
+            except Exception as e:  # noqa: BLE001 — sweep must finish
+                report.add(Finding(
+                    "TW000", ERROR, fam,
+                    f"scenario failed to build under lint: {e!r}"))
+                continue
+            report.extend(lint_scenario(sc, probe=probe, seed=seed))
+    for fam, mods in modules.items():
+        for mod in mods:
+            subjects += 1
+            try:
+                report.extend(lint_module_programs(_m(mod)))
+            except Exception as e:  # noqa: BLE001 — sweep must finish
+                report.add(Finding(
+                    "TW000", ERROR, fam,
+                    f"program module {mod!r} failed to lint: {e!r}"))
+    return subjects, report
+
+
+def lint_main(argv) -> int:
+    """``timewarp-tpu lint``: run the scenario sanitizer (jaxpr
+    contract lints + static capacity proofs + commutative-inbox
+    permutation probes) over shipped state-machine models, and the
+    effect-program AST linter over their ``_net`` twins. Exits 1 on
+    any error-severity finding — the CI lint gate."""
+    p = argparse.ArgumentParser(
+        prog="timewarp-tpu lint",
+        description="Static scenario sanitizer (timewarp_tpu.analysis)."
+                    " With no arguments, sweeps every shipped model.")
+    p.add_argument("families", nargs="*",
+                   help="scenario families to lint (default: all): "
+                        "token-ring gossip praos ping-pong socket-state")
+    p.add_argument("--nodes", type=int, default=64,
+                   help="node count the swept scenarios are built at")
+    p.add_argument("--no-probe", action="store_true",
+                   help="skip the commutative-inbox permutation probe "
+                        "(the only check that executes the step)")
+    p.add_argument("--seed", type=int, default=0,
+                   help="probe permutation seed")
+    p.add_argument("--json", action="store_true",
+                   help="one JSON report line instead of findings text")
+    args = p.parse_args(argv)
+
+    subjects, report = lint_sweep(args.families or None,
+                                  nodes=args.nodes,
+                                  probe=not args.no_probe,
+                                  seed=args.seed)
+
+    if args.json:
+        print(json.dumps({"subjects": subjects, **report.to_json()}))
+    else:
+        print(report.render())
+        print(f"({subjects} subjects linted)")
+    return 0 if report.ok else 1
+
+
 def main(argv=None) -> int:
+    if argv is None:
+        argv = sys.argv[1:]
+    argv = list(argv)
+    if argv and argv[0] == "lint":
+        return lint_main(argv[1:])
     p = argparse.ArgumentParser(
         prog="timewarp_tpu",
         description="Run a distributed-system scenario under an "
@@ -199,6 +359,13 @@ def main(argv=None) -> int:
                    help="resume from a checkpoint written by --save")
     p.add_argument("--log-config", default=None,
                    help="YAML severity tree (utils/logconfig.py)")
+    p.add_argument("--lint", default="warn",
+                   choices=["error", "warn", "off"],
+                   help="construction-time scenario sanitizer "
+                        "(analysis/): 'warn' logs findings (default), "
+                        "'error' refuses to run a scenario with "
+                        "error-severity findings, 'off' skips the "
+                        "checks entirely")
     args = p.parse_args(argv)
 
     from .utils.logconfig import load_log_config

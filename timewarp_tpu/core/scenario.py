@@ -130,6 +130,41 @@ class Scenario:
     #: metadata for bench/trace tooling
     meta: dict = field(default_factory=dict)
 
+    def __post_init__(self) -> None:
+        """Declaration sanity — actionable errors at build time instead
+        of shape mismatches (or silence) deep inside an engine. The
+        deeper semantic checks (step dataflow, capacity proofs, flag
+        validation) live in :mod:`timewarp_tpu.analysis`."""
+        import numpy as _np
+        for attr, why in (
+                ("n_nodes", "a scenario needs at least one node"),
+                ("mailbox_cap",
+                 "every node needs at least one mailbox slot "
+                 "(determinism contract #6 bounds, not eliminates, it)"),
+                ("max_out",
+                 "the outbox is fixed-width; width 0 could never send "
+                 "(use valid=False lanes for silent firings)"),
+                ("payload_width",
+                 "payload arrays are fixed-width [max_out, "
+                 "payload_width]; width 0 has no batchable layout")):
+            v = getattr(self, attr)
+            # numpy integer scalars (array shapes, loaded configs) are
+            # fine; bools are not (True would silently mean 1)
+            if isinstance(v, bool) \
+                    or not isinstance(v, (int, _np.integer)) or v < 1:
+                raise ValueError(
+                    f"scenario {self.name!r}: {attr} must be an int "
+                    f">= 1, got {v!r} — {why}")
+        if self.static_dst is not None:
+            shape = tuple(_np.shape(self.static_dst))
+            want = (self.n_nodes, self.max_out)
+            if shape != want:
+                raise ValueError(
+                    f"scenario {self.name!r}: static_dst shape {shape} "
+                    f"must be [n_nodes, max_out] = {list(want)} — one "
+                    "destination per outbox slot per node (-1 = slot "
+                    "never used)")
+
     def empty_outbox(self, np_mod: Any) -> Outbox:
         """Convenience for step functions: an all-invalid outbox."""
         M, P = self.max_out, self.payload_width

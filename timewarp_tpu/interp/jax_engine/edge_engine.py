@@ -190,7 +190,13 @@ class EdgeEngine:
     trace work compiled in)."""
 
     def __init__(self, scenario: Scenario, link: LinkModel, *,
-                 seed: int = 0, cap: int = 2) -> None:
+                 seed: int = 0, cap: int = 2,
+                 lint: str = "warn") -> None:
+        # static scenario sanitizer — same knob contract as JaxEngine
+        from ...analysis import check_scenario
+        self.lint = lint
+        self.lint_report = check_scenario(scenario, lint,
+                                          who=type(self).__name__)
         if scenario.static_dst is None:
             raise ValueError(
                 f"scenario {scenario.name!r} declares no static_dst; "

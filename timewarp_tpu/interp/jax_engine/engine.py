@@ -169,7 +169,16 @@ class JaxEngine:
     def __init__(self, scenario: Scenario, link: LinkModel, *,
                  seed: int = 0, window=1,
                  route_cap: Optional[int] = None,
-                 record_events: int = 0) -> None:
+                 record_events: int = 0,
+                 lint: str = "warn") -> None:
+        # static scenario sanitizer (analysis/): "warn" logs findings,
+        # "error" refuses to construct on contract violations, "off"
+        # skips entirely (bit-for-bit the pre-lint behavior — the
+        # checks are abstract and never execute the step)
+        from ...analysis import check_scenario
+        self.lint = lint
+        self.lint_report = check_scenario(scenario, lint,
+                                          who=type(self).__name__)
         if scenario.n_nodes * scenario.max_out >= 2**31:
             raise ValueError(
                 "n_nodes * max_out must fit int32 (sender-major rank)")

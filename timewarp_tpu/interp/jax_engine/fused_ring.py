@@ -288,8 +288,13 @@ class FusedRingEngine:
     as :class:`EdgeEngine`; ``to_edge_state`` converts back for the
     exact-equality law."""
 
-    def __init__(self, scenario: Scenario, link, *, cap: int = 2
-                 ) -> None:
+    def __init__(self, scenario: Scenario, link, *, cap: int = 2,
+                 lint: str = "warn") -> None:
+        # static scenario sanitizer — same knob contract as EdgeEngine
+        from ...analysis import check_scenario
+        self.lint = lint
+        self.lint_report = check_scenario(scenario, lint,
+                                          who=type(self).__name__)
         if not isinstance(link, FixedDelay):
             raise ValueError("FusedRingEngine supports FixedDelay "
                              "links (delay is a kernel scalar)")

@@ -523,9 +523,11 @@ class FusedSparseEngine(JaxEngine):
 
     def __init__(self, scenario: Scenario, link: LinkModel, *,
                  seed: int = 0, window=1, record_events: int = 0,
-                 max_batch: int = 1 << 16) -> None:
+                 max_batch: int = 1 << 16,
+                 lint: str = "warn") -> None:
         super().__init__(scenario, link, seed=seed, window=window,
-                         route_cap=None, record_events=record_events)
+                         route_cap=None, record_events=record_events,
+                         lint=lint)
         sc = scenario
         if link.can_drop:
             raise ValueError(

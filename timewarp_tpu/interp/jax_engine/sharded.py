@@ -46,8 +46,8 @@ class ShardedEdgeEngine(ShardedDriver, EdgeEngine):
 
     def __init__(self, scenario: Scenario, link: LinkModel,
                  mesh: Mesh, *, axis: AxisName = "nodes", seed: int = 0,
-                 cap: int = 2) -> None:
-        super().__init__(scenario, link, seed=seed, cap=cap)
+                 cap: int = 2, lint: str = "warn") -> None:
+        super().__init__(scenario, link, seed=seed, cap=cap, lint=lint)
         bad = [e for e, s in enumerate(self.topo.shift) if s is None]
         if bad:
             raise ValueError(
@@ -98,9 +98,10 @@ class ShardedEngine(ShardedDriver, JaxEngine):
                  mesh: Mesh, *, axis: AxisName = "nodes", seed: int = 0,
                  bucket_cap: Optional[int] = None,
                  window: int = 1,
-                 route_cap: Optional[int] = None) -> None:
+                 route_cap: Optional[int] = None,
+                 lint: str = "warn") -> None:
         super().__init__(scenario, link, seed=seed, window=window,
-                         route_cap=route_cap)
+                         route_cap=route_cap, lint=lint)
         self.mesh = mesh
         self.axis = axis
         D = axis_size(mesh, axis)
@@ -189,10 +190,10 @@ class ShardedFusedSparseEngine(ShardedEngine):
     def __init__(self, scenario: Scenario, link: LinkModel,
                  mesh: Mesh, *, axis: AxisName = "nodes", seed: int = 0,
                  bucket_cap: Optional[int] = None,
-                 window: int = 1) -> None:
+                 window: int = 1, lint: str = "warn") -> None:
         super().__init__(scenario, link, mesh, axis=axis, seed=seed,
                          bucket_cap=bucket_cap, window=window,
-                         route_cap=None)
+                         route_cap=None, lint=lint)
         from .fused_sparse import _build_kernel, _insertion_plan
         sc = scenario
         nl = self.comm.n_local

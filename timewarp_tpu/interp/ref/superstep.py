@@ -56,7 +56,15 @@ class SuperstepOracle:
 
     def __init__(self, scenario: Scenario, link: LinkModel, *,
                  seed: int = 0, record_events: bool = False,
-                 window=1) -> None:
+                 window=1, lint: str = "warn") -> None:
+        # static scenario sanitizer — same knob contract as the
+        # engines (analysis/check_scenario); the oracle is the
+        # referee, so catching a contract violation here names it
+        # before a digest mismatch would
+        from ...analysis import check_scenario
+        self.lint = lint
+        self.lint_report = check_scenario(scenario, lint,
+                                          who=type(self).__name__)
         if isinstance(window, str) and window != "auto":
             # mirror JaxEngine: a typo'd "Auto"/"8ms" from a library
             # caller must fail clearly, not as `window < 1`'s opaque
