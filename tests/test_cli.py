@@ -108,6 +108,72 @@ def test_cli_oracle_rejects_checkpoint_flags(tmp_path):
               "--save", str(tmp_path / "x.npz")])
 
 
+def test_cli_batched_run_and_guards(capsys, tmp_path):
+    """--batch/--seeds: a 2-world fleet on the general engine reports
+    per-world counters; engines without the world axis reject the
+    flags with an actionable error (same never-silent guard style as
+    the other engine-compat checks)."""
+    common = ["gossip", "--nodes", "48", "--steps", "120", "--burst",
+              "--fanout", "4", "--end-us", "200000",
+              "--link", "quantize:1000:uniform:2000:8000"]
+    r = run_cli(capsys, *common, "--batch", "2")
+    assert r["worlds"] == 2 and r["seeds"] == [0, 1]
+    assert len(r["delivered"]) == 2 and len(r["supersteps"]) == 2
+    # --seeds a:b names the worlds; world seeds must match solo runs
+    r2 = run_cli(capsys, *common, "--seeds", "7:9")
+    assert r2["seeds"] == [7, 8]
+    solo = run_cli(capsys, *common, "--seed", "7")
+    assert r2["delivered"][0] == solo["delivered"]
+    assert r2["supersteps"][0] == solo["supersteps"]
+    # batched trace CSV carries the world column
+    csv_path = tmp_path / "fleet.csv"
+    r3 = run_cli(capsys, *common, "--batch", "2",
+                 "--trace-csv", str(csv_path))
+    with open(csv_path) as f:
+        rows = list(csv.reader(f))
+    assert rows[0][0] == "world"
+    assert len(rows) - 1 == sum(r3["supersteps"])
+    # world-axis guards: actionable, never silent
+    for eng in ("oracle", "edge", "fused-sparse", "sharded"):
+        with pytest.raises(SystemExit, match="world axis"):
+            main([*common, "--engine", eng, "--batch", "2"])
+    with pytest.raises(SystemExit, match="world axis"):
+        main([*common, "--engine", "edge", "--seeds", "0:2"])
+    with pytest.raises(SystemExit, match="needs --batch"):
+        main([*common, "--engine", "sharded-batched"])
+    with pytest.raises(SystemExit, match="solo-run debug ring"):
+        main([*common, "--batch", "2", "--record-events", "16"])
+    with pytest.raises(SystemExit, match="disagrees"):
+        main([*common, "--batch", "3", "--seeds", "0:2"])
+
+
+def test_cli_sharded_batched_matches_general_batched(capsys):
+    common = ["token-ring", "--nodes", "32", "--steps", "100",
+              "--tokens", "4", "--think-us", "10000",
+              "--link", "uniform:1000:5000", "--seeds", "1:5"]
+    loc = run_cli(capsys, *common)  # general engine carries the fleet
+    sh = run_cli(capsys, *common, "--engine", "sharded-batched",
+                 "--devices", "4")
+    assert sh["engine"] == "sharded-batched"
+    assert sh["delivered"] == loc["delivered"]
+    assert sh["supersteps"] == loc["supersteps"]
+    assert sh["virtual_time_us"] == loc["virtual_time_us"]
+
+
+def test_cli_batched_checkpoint_seed_fleet_pinned(capsys, tmp_path):
+    """A fleet checkpoint resumes only under ITS seed fleet — silently
+    adopting different worlds would diverge every RNG stream."""
+    ck = tmp_path / "fleet.npz"
+    common = ["token-ring", "--nodes", "32", "--steps", "80",
+              "--tokens", "4", "--think-us", "10000",
+              "--link", "uniform:1000:5000"]
+    run_cli(capsys, *common, "--seeds", "3:5", "--save", str(ck))
+    with pytest.raises(SystemExit, match="matching --batch/--seeds"):
+        main([*common, "--seeds", "0:2", "--resume", str(ck)])
+    r = run_cli(capsys, *common, "--seeds", "3:5", "--resume", str(ck))
+    assert r["seeds"] == [3, 4]
+
+
 def test_parse_link_malformed_specs_name_the_grammar():
     # these used to die with a raw IndexError / ValueError
     for bad in ("uniform:5", "fixed:x", "lognormal:1000",

@@ -30,6 +30,38 @@ def _window_arg(s: str):
     return "auto" if s == "auto" else int(s)
 
 
+def _seeds_arg(s: str):
+    """--seeds takes a half-open world-seed range ``a:b`` (world k
+    runs seed a+k; b-a worlds total)."""
+    import argparse as _ap
+    try:
+        a, b = s.split(":")
+        a, b = int(a), int(b)
+    except ValueError:
+        raise _ap.ArgumentTypeError(
+            f"--seeds takes a half-open integer range a:b, got {s!r}")
+    if b <= a:
+        raise _ap.ArgumentTypeError(
+            f"--seeds range {s!r} is empty (need b > a)")
+    return range(a, b)
+
+
+#: engines that carry the world axis (--batch / --seeds)
+BATCH_ENGINES = ("general", "sharded-batched")
+
+
+def build_batch(args):
+    """The world-axis spec from --batch/--seeds, or None (solo)."""
+    if args.batch is None and args.seeds is None:
+        return None
+    from .interp.jax_engine.batched import BatchSpec
+    try:
+        return BatchSpec.of(args.batch, args.seeds,
+                            base_seed=args.seed)
+    except ValueError as e:
+        raise SystemExit(str(e)) from None
+
+
 #: the --link grammar, named in every parse error
 LINK_GRAMMAR = ("fixed:D | uniform:LO:HI | lognormal:MEDIAN:SIGMA | "
                 "drop:P:<inner> | quantize:Q:<inner>  "
@@ -106,8 +138,25 @@ def build_scenario(args):
 
 
 def build_engine(args, sc, link):
+    batch = build_batch(args)
     # never-silent: reject knobs an engine would ignore rather than
     # letting cross-engine comparisons diverge mysteriously
+    if batch is not None and args.engine not in BATCH_ENGINES:
+        raise SystemExit(
+            f"--batch/--seeds add a world axis; only the general XLA "
+            f"engines carry one ({', '.join(BATCH_ENGINES)}) — "
+            f"{args.engine} runs exactly one world (run it once per "
+            "seed, or switch engines)")
+    if batch is None and args.engine == "sharded-batched":
+        raise SystemExit(
+            "sharded-batched shards the world axis over the mesh; "
+            "it needs --batch B or --seeds a:b (one sharded world "
+            "is --engine sharded)")
+    if batch is not None and args.record_events:
+        raise SystemExit(
+            "--record-events is a solo-run debug ring; record world "
+            "b's events by running that seed solo (bit-identical by "
+            "the batch exactness law, batched.py)")
     if args.engine not in ("general", "fused-sparse") \
             and args.record_events:
         raise SystemExit(
@@ -120,7 +169,7 @@ def build_engine(args, sc, link):
         raise SystemExit(
             f"--window applies to the general engines only; "
             f"{args.engine} runs classic supersteps")
-    if (args.engine not in ("general", "sharded")
+    if (args.engine not in ("general", "sharded", "sharded-batched")
             and args.route_cap is not None):
         raise SystemExit(
             f"--route-cap applies to the XLA general engines only; "
@@ -142,7 +191,14 @@ def build_engine(args, sc, link):
         return JaxEngine(sc, link, seed=args.seed, window=args.window,
                          route_cap=args.route_cap,
                          record_events=args.record_events,
-                         lint=args.lint)
+                         lint=args.lint, batch=batch)
+    if args.engine == "sharded-batched":
+        from .interp.jax_engine.sharded import (ShardedBatchedEngine,
+                                                make_mesh)
+        return ShardedBatchedEngine(
+            sc, link, make_mesh(args.devices, axis="worlds"),
+            batch=batch, seed=args.seed, window=args.window,
+            route_cap=args.route_cap, lint=args.lint)
     if args.engine == "fused-sparse":
         from .interp.jax_engine.fused_sparse import FusedSparseEngine
         kw = {} if args.max_batch is None else {
@@ -308,7 +364,7 @@ def main(argv=None) -> int:
     p.add_argument("--engine", default="general",
                    choices=["oracle", "general", "fused-sparse",
                             "edge", "sharded", "sharded-edge",
-                            "sharded-fused"])
+                            "sharded-fused", "sharded-batched"])
     p.add_argument("--nodes", type=int, default=64)
     p.add_argument("--steps", type=int, default=1000,
                    help="max supersteps to run")
@@ -316,6 +372,15 @@ def main(argv=None) -> int:
                    help="fixed:D | uniform:LO:HI | lognormal:MED:SIGMA"
                         " | drop:P:<inner> | quantize:Q:<inner>")
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--batch", type=int, default=None,
+                   help="world count B: run B independent emulations "
+                        "of this scenario in one batched engine "
+                        "(seeds --seed .. --seed+B-1); general XLA "
+                        "engines only")
+    p.add_argument("--seeds", type=_seeds_arg, default=None,
+                   help="explicit world-seed range a:b (half-open) "
+                        "for the batched world axis; implies the "
+                        "world count")
     p.add_argument("--devices", type=int, default=None,
                    help="mesh size for sharded engines (default: all)")
     p.add_argument("--mailbox-cap", type=int, default=8)
@@ -384,12 +449,24 @@ def main(argv=None) -> int:
         final_info = {"overflow": engine.overflow_total,
                       "bad_dst": engine.bad_dst_total}
     else:
+        import numpy as np
+        batched = getattr(engine, "batch", None)
         state = None
         if args.resume:
             from .utils.checkpoint import load_state
             state, ck_meta = load_state(args.resume, engine.init_state(),
                                         expect_meta={"scenario": sc.name})
-            if ck_meta.get("seed", args.seed) != args.seed:
+            if batched is not None:
+                if ck_meta.get("seeds") != list(batched.seeds):
+                    # per-world RNG streams are part of the state:
+                    # silently adopting different seeds would make the
+                    # resumed fleet match neither run
+                    raise SystemExit(
+                        f"checkpoint holds the world fleet "
+                        f"{ck_meta.get('seeds')}; resuming it under "
+                        f"{list(batched.seeds)} would diverge — pass "
+                        "the matching --batch/--seeds")
+            elif ck_meta.get("seed", args.seed) != args.seed:
                 # the RNG stream is part of the state: resuming under a
                 # different seed would silently diverge from both runs
                 args.seed = ck_meta["seed"]
@@ -397,11 +474,23 @@ def main(argv=None) -> int:
         final, trace = engine.run(args.steps, state=state)
         if args.save:
             from .utils.checkpoint import save_state
-            save_state(args.save, final,
-                       meta={"scenario": sc.name, "seed": args.seed})
-        final_info = {"overflow": int(final.overflow),
-                      "steps": int(final.steps),
-                      "virtual_time_us": int(final.time)}
+            meta = {"scenario": sc.name, "seed": args.seed}
+            if batched is not None:
+                meta["seeds"] = list(batched.seeds)
+            save_state(args.save, final, meta=meta)
+        if batched is not None:
+            # per-world counters: the whole point of the fleet is that
+            # worlds differ — aggregate in your own tooling, not here
+            final_info = {
+                "worlds": batched.B,
+                "seeds": list(batched.seeds),
+                "overflow": np.asarray(final.overflow).tolist(),
+                "steps": np.asarray(final.steps).tolist(),
+                "virtual_time_us": np.asarray(final.time).tolist()}
+        else:
+            final_info = {"overflow": int(final.overflow),
+                          "steps": int(final.steps),
+                          "virtual_time_us": int(final.time)}
 
     if args.events_csv:
         import csv
@@ -420,17 +509,32 @@ def main(argv=None) -> int:
         import csv
         with open(args.trace_csv, "w", newline="") as f:
             w = csv.writer(f)
-            w.writerow(["t_us", "fired", "fired_hash", "recv",
-                        "recv_hash", "sent", "sent_hash", "overflow"])
-            for i in range(len(trace)):
-                w.writerow(trace.row(i))
+            if isinstance(trace, list):
+                # batched: one row block per world, world id leading
+                w.writerow(["world", "t_us", "fired", "fired_hash",
+                            "recv", "recv_hash", "sent", "sent_hash",
+                            "overflow"])
+                for b, tr in enumerate(trace):
+                    for i in range(len(tr)):
+                        w.writerow((b,) + tr.row(i))
+            else:
+                w.writerow(["t_us", "fired", "fired_hash", "recv",
+                            "recv_hash", "sent", "sent_hash",
+                            "overflow"])
+                for i in range(len(trace)):
+                    w.writerow(trace.row(i))
 
-    print(json.dumps({
-        "scenario": sc.name, "engine": args.engine,
-        "supersteps": len(trace),
-        "delivered": trace.total_delivered(),
-        **final_info,
-    }))
+    if isinstance(trace, list):
+        summary = {"scenario": sc.name, "engine": args.engine,
+                   "supersteps": [len(t) for t in trace],
+                   "delivered": [t.total_delivered() for t in trace],
+                   **final_info}
+    else:
+        summary = {"scenario": sc.name, "engine": args.engine,
+                   "supersteps": len(trace),
+                   "delivered": trace.total_delivered(),
+                   **final_info}
+    print(json.dumps(summary))
     return 0
 
 

@@ -15,7 +15,42 @@ import numpy as np
 from ...ops.numeric import I32MAX, group_rank, thi, tlo, u32sum
 
 __all__ = ["LocalComm", "StepOut", "I32MAX", "group_rank", "u32sum",
-           "tlo", "thi"]
+           "tlo", "thi", "padded_scan", "scan_pad"]
+
+
+def scan_pad(max_steps: int) -> int:
+    """Scan length for a ``max_steps`` budget: the next power of two.
+    The scan length is the ONLY static compile input of the traced
+    drivers, so rounding it up to a pow2 bucket (and masking the tail
+    supersteps out — :func:`padded_scan`) collapses every budget in a
+    bucket onto one executable — ``run(100)`` then ``run(120)`` reuse
+    the 128-step program instead of recompiling
+    (tests/test_world_batch.py pins the compile count). The masked
+    tail still *executes* (its results are discarded), bounding the
+    waste at <2x supersteps — cheap next to a 20-40 s TPU compile per
+    distinct budget."""
+    if max_steps <= 0:
+        return 0
+    return 1 << (max_steps - 1).bit_length()
+
+
+def padded_scan(step_all, st, n_pad: int, max_steps):
+    """The ONE pow2-padded masked-tail scan body every traced driver
+    shares (local, edge, sharded — a single implementation so the
+    run/freeze/zero contract cannot drift per driver): iterations at
+    index >= ``max_steps`` (traced) compute and discard their
+    superstep, freezing the carry and zeroing the trace row
+    (valid=False, filtered host-side). ``step_all`` is the engine's
+    one-driver-step hook ``(carry, with_trace) -> (carry', yrow)``."""
+    def body(carry, i):
+        new, y = step_all(carry, True)
+        run = i < max_steps
+        carry = jax.tree.map(
+            lambda a, b: jnp.where(run, b, a), carry, new)
+        y = jax.tree.map(
+            lambda x: jnp.where(run, x, jnp.zeros_like(x)), y)
+        return carry, y
+    return jax.lax.scan(body, st, jnp.arange(n_pad, dtype=jnp.int64))
 
 
 class StepOut(NamedTuple):

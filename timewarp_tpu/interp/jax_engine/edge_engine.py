@@ -59,6 +59,7 @@ from ...trace.events import SuperstepTrace
 from ...trace.hashing import FIRED, RECV, SENT, mix32_jnp
 from .common import I32MAX as _I32MAX
 from .common import LocalComm, StepOut as _StepOut
+from .common import padded_scan, scan_pad
 from .common import thi as _thi, tlo as _tlo, u32sum as _u32sum
 
 __all__ = ["EdgeEngine", "EdgeState", "EdgeTopology"]
@@ -467,11 +468,32 @@ class EdgeEngine:
                       carry.time + qmin.astype(jnp.int64),
                       jnp.int64(NEVER)))
 
+    #: the edge engine carries no world axis (batch=BatchSpec is the
+    #: general engine's lever); the shared drivers key off this
+    batch = None
+
+    def _step_all(self, st, with_trace: bool):
+        """One driver step (the ShardedDriver/scan hook — the edge
+        engine has no world axis, so this is always the solo step)."""
+        return self._superstep(st, with_trace)
+
+    def _while_cond_fn(self, start_steps, max_steps):
+        def cond(carry):
+            nxt = self.comm.all_min(self._next_event(carry))
+            return (nxt < NEVER) & \
+                (carry.steps - start_steps < max_steps)
+        return cond
+
+    def _while_body_fn(self, start_steps, max_steps):
+        def body(carry):
+            return self._step_all(carry, False)[0]
+        return body
+
     @partial(jax.jit, static_argnums=(0, 2))
-    def _run_scan(self, st: EdgeState, max_steps: int):
-        def body(carry, _):
-            return self._superstep(carry, True)
-        return jax.lax.scan(body, st, None, length=max_steps)
+    def _run_scan(self, st: EdgeState, n_pad: int, max_steps):
+        # pow2-padded scan length + masked tail, the shared
+        # compile-reuse contract (common.py scan_pad/padded_scan)
+        return padded_scan(self._step_all, st, n_pad, max_steps)
 
     def _warn_on_overflow(self, final: EdgeState) -> None:
         """Per-edge capacity (``cap``) is NOT the oracle's per-node
@@ -493,7 +515,8 @@ class EdgeEngine:
             state: Optional[EdgeState] = None
             ) -> Tuple[EdgeState, SuperstepTrace]:
         st = state if state is not None else self.init_state()
-        final, ys = self._run_scan(st, max_steps)
+        final, ys = self._run_scan(st, scan_pad(max_steps),
+                                   jnp.asarray(max_steps, jnp.int64))
         self._warn_on_overflow(final)
         ys = jax.device_get(ys)
         m = np.asarray(ys.valid)
@@ -508,15 +531,9 @@ class EdgeEngine:
     def _run_while(self, st: EdgeState, max_steps) -> EdgeState:
         start_steps = st.steps
         max_steps = jnp.asarray(max_steps, jnp.int64)
-
-        def cond(carry):
-            nxt = self.comm.all_min(self._next_event(carry))
-            return (nxt < NEVER) & (carry.steps - start_steps < max_steps)
-
-        def body(carry):
-            return self._superstep(carry, False)[0]
-
-        return jax.lax.while_loop(cond, body, st)
+        return jax.lax.while_loop(
+            self._while_cond_fn(start_steps, max_steps),
+            self._while_body_fn(start_steps, max_steps), st)
 
     def run_quiet(self, max_steps: int,
                   state: Optional[EdgeState] = None) -> EdgeState:

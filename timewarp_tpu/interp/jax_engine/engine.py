@@ -47,11 +47,13 @@ from ...core.scenario import NEVER, Inbox, Outbox, Scenario
 from ...net.delays import LinkModel
 from ...trace.events import SuperstepTrace
 from ...trace.hashing import FIRED, RECV, SENT, mix32_jnp
+from .batched import BatchSpec, rebind_link
 from .common import I32MAX as _I32MAX
 from .common import LocalComm, StepOut as _StepOut, group_rank
+from .common import padded_scan, scan_pad as _scan_pad
 from .common import thi as _thi, tlo as _tlo, u32sum as _u32sum
 
-__all__ = ["JaxEngine", "EngineState"]
+__all__ = ["JaxEngine", "EngineState", "BatchSpec"]
 
 
 class EngineState(NamedTuple):
@@ -164,13 +166,31 @@ class JaxEngine:
     dropped (``route_drop`` stays 0 by construction), so no capacity
     knob needs hand-tuning. Event semantics, arrival order (contract
     #3) and digests are identical to the eager path.
+
+    Batched multi-world execution (``batch=BatchSpec``, batched.py):
+    a leading world axis B through the whole engine. ``_superstep`` is
+    ``vmap``-ed over B independent worlds sharing one scenario but
+    differing in seed and (optionally) link-model parameters; every
+    ``EngineState`` leaf gains a leading B dim (so checkpoints,
+    counters, and trace digests are per-world), the drivers mask
+    quiescence and step budgets per world, and ``run`` returns one
+    :class:`SuperstepTrace` per world. Slicing world b out of a
+    batched run is **bit-identical** to the solo run with that seed
+    and link — the batch exactness law (batched.py module docstring).
+    The fleet amortizes the superstep's fixed N-width costs (the
+    sender-compaction sort, the [K, N] mailbox passes) into one
+    batched op serving B worlds — the replica-sweep throughput lever
+    (PERF_r05.md). ``record_events`` is solo-only (the ring decoder is
+    a single-run debug artifact — record world b's events by running
+    it solo, which is bit-identical by the law above).
     """
 
     def __init__(self, scenario: Scenario, link: LinkModel, *,
                  seed: int = 0, window=1,
                  route_cap: Optional[int] = None,
                  record_events: int = 0,
-                 lint: str = "warn") -> None:
+                 lint: str = "warn",
+                 batch: Optional[BatchSpec] = None) -> None:
         # static scenario sanitizer (analysis/): "warn" logs findings,
         # "error" refuses to construct on contract violations, "off"
         # skips entirely (bit-for-bit the pre-lint behavior — the
@@ -184,6 +204,25 @@ class JaxEngine:
                 "n_nodes * max_out must fit int32 (sender-major rank)")
         if record_events < 0:
             raise ValueError("record_events must be >= 0")
+        self.batch = batch
+        if batch is not None:
+            if not isinstance(batch, BatchSpec):
+                raise ValueError(
+                    f"batch must be a BatchSpec (got {batch!r}); build "
+                    "one with BatchSpec(seeds=...) or BatchSpec.of()")
+            if record_events:
+                raise ValueError(
+                    "record_events is a solo-run debug ring; to record "
+                    "world b's events, run it solo (bit-identical by "
+                    "the batch exactness law, batched.py)")
+            #: per-world host-level links — what a solo run must use to
+            #: reproduce world b, and the floor for window validation
+            self._world_links = [batch.world_link(link, b)
+                                 for b in range(batch.B)]
+            link_floor = min(lk.min_delay_us for lk in self._world_links)
+        else:
+            self._world_links = None
+            link_floor = link.min_delay_us
         if isinstance(window, str) and window != "auto":
             # a typo'd "Auto"/"8ms" from a library caller would
             # otherwise fall through to `window < 1` and raise an
@@ -196,16 +235,18 @@ class JaxEngine:
             # is declared >= min_delay_us, so instants within that
             # span are causally independent (class docstring). A
             # floor-less link (min 1) degenerates to the classic
-            # engine — correct, just unbatched.
-            window = max(1, int(link.min_delay_us))
+            # engine — correct, just unbatched. Batched: the min over
+            # every world's link, so the window is exact fleet-wide.
+            window = max(1, int(link_floor))
         if window < 1:
             raise ValueError(f"window must be >= 1 µs, got {window}")
-        if window > 1 and window > link.min_delay_us:
+        if window > 1 and window > link_floor:
             raise ValueError(
                 f"window={window} µs exceeds the link model's declared "
-                f"min_delay_us={link.min_delay_us}; windowed supersteps "
-                "would reorder causally dependent events (engine.py "
-                "windowed-execution precondition)")
+                f"min_delay_us={link_floor}"
+                f"{' (min over the batch worlds)' if batch else ''}; "
+                "windowed supersteps would reorder causally dependent "
+                "events (engine.py windowed-execution precondition)")
         if window >= _I32MAX:
             raise ValueError("window must fit int32")
         if route_cap is not None and route_cap < 1:
@@ -223,6 +264,16 @@ class JaxEngine:
         #: record-level equality)
         self.record_events = int(record_events)
         self.s0, self.s1 = seed_words(seed)
+        if batch is not None:
+            # per-world seed words + link-parameter vectors: the world
+            # context the vmapped superstep maps over. batch.seeds
+            # REPLACES the solo `seed` argument (world b's stream is
+            # exactly JaxEngine(..., seed=batch.seeds[b])'s).
+            sw = [seed_words(s) for s in batch.seeds]
+            self._s0v = jnp.asarray([a for a, _ in sw], jnp.uint32)
+            self._s1v = jnp.asarray([b for _, b in sw], jnp.uint32)
+            self._lpv = {k: jnp.asarray(v) for k, v in
+                         (batch.link_params or {}).items()}
         self.comm = LocalComm(scenario.n_nodes)
         #: subclasses whose routing stage derives mailbox holes while
         #: the block is already in VMEM (fused_sparse.py) set this to
@@ -243,7 +294,7 @@ class JaxEngine:
                 lambda *xs: jnp.stack([jnp.asarray(x) for x in xs]),
                 *[p[0] for p in per])
             wake = jnp.asarray([p[1] for p in per], jnp.int64)
-        return EngineState(
+        st = EngineState(
             states=states,
             wake=wake,
             mb_rel=jnp.full((K, n), _I32MAX, jnp.int32),
@@ -261,6 +312,14 @@ class JaxEngine:
             ev_meta=jnp.zeros((4, self.record_events), jnp.int32),
             ev_count=jnp.int64(0),
         )
+        if self.batch is not None:
+            # the world axis: every leaf gains a leading B dim. Worlds
+            # share the scenario's (seed-independent) initial state;
+            # they diverge from superstep 1 via per-world entropy.
+            B = self.batch.B
+            st = jax.tree.map(
+                lambda x: jnp.repeat(x[None], B, axis=0), st)
+        return st
 
     # -- one superstep ---------------------------------------------------
 
@@ -458,8 +517,14 @@ class JaxEngine:
             return branch
 
         rungs = self._sender_rungs(n)
-        if len(rungs) == 1:
-            return tail(rungs[0])()
+        if len(rungs) == 1 or self.batch is not None:
+            # batched: pin the top rung. Under vmap a batched
+            # lax.switch lowers to select-over-ALL-branches, so the
+            # ladder would pay every rung for every world; the top
+            # rung is result-identical to any fitting rung by
+            # construction (only cost differs), so the exactness law
+            # is untouched.
+            return tail(rungs[-1])()
         idx = jnp.sum(n_active > jnp.asarray(rungs, jnp.int32))
         return jax.lax.switch(idx, [tail(A) for A in rungs])
 
@@ -884,22 +949,115 @@ class JaxEngine:
             lambda x: jnp.where(live, x, jnp.zeros_like(x)), yrow)
         return final, yrow
 
+    # -- the world axis (batch=BatchSpec) --------------------------------
+
+    def _vstep(self, st, s0v, s1v, lpv, with_trace: bool):
+        """One superstep of every world: ``vmap`` of ``_superstep``
+        over the leading world axis of ``st`` and the world context
+        (per-world seed words + link parameters). The per-world seed
+        and link are bound onto ``self`` for the single trace vmap
+        performs — the traced values ARE the per-world tracers, so the
+        compiled program maps them; ``_superstep`` itself is
+        unchanged (the whole point: one superstep implementation,
+        solo or fleet)."""
+        def world(st_w, s0, s1, lp):
+            prev = (self.s0, self.s1, self.link)
+            self.s0, self.s1 = s0, s1
+            if lp:
+                self.link = rebind_link(self.link, lp)
+            try:
+                return self._superstep(st_w, with_trace)
+            finally:
+                self.s0, self.s1, self.link = prev
+        return jax.vmap(world, in_axes=(0, 0, 0, 0))(st, s0v, s1v, lpv)
+
+    def _step_all(self, st, with_trace: bool):
+        """One driver step: the solo superstep, or the vmapped fleet."""
+        if self.batch is None:
+            return self._superstep(st, with_trace)
+        return self._vstep(st, self._s0v, self._s1v, self._lpv,
+                           with_trace)
+
+    def _any_world(self, x):
+        """Whether any world (on any device) is still active — the
+        while-loop liveness reduction. Identity single-chip; the
+        world-sharded engine overrides with a mesh psum."""
+        return x
+
+    def _while_cond_fn(self, start_steps, max_steps):
+        """The run_quiet loop condition. Batched: a world is active
+        while it has events pending AND is inside its own step budget
+        — both per world, so a finished world never runs past where
+        its solo run would stop (the exactness law's driver half)."""
+        if self.batch is None:
+            def cond(carry):
+                nxt = self.comm.all_min(self._next_event(carry))
+                return (nxt < NEVER) & \
+                    (carry.steps - start_steps < max_steps)
+        else:
+            def cond(carry):
+                nxt = jax.vmap(self._next_event)(carry)
+                active = (nxt < NEVER) & \
+                    (carry.steps - start_steps < max_steps)
+                return self._any_world(jnp.any(active))
+        return cond
+
+    def _while_body_fn(self, start_steps, max_steps):
+        """The run_quiet loop body. Batched: budget-exhausted worlds
+        are frozen leaf-wise (quiesced worlds are already frozen
+        inside ``_superstep`` by the ``live`` mask)."""
+        if self.batch is None:
+            def body(carry):
+                return self._step_all(carry, False)[0]
+        else:
+            def body(carry):
+                new = self._step_all(carry, False)[0]
+                act = carry.steps - start_steps < max_steps  # [B]
+                return jax.tree.map(
+                    lambda a, b: jnp.where(
+                        act.reshape(act.shape + (1,) * (b.ndim - 1)),
+                        b, a),
+                    carry, new)
+        return body
+
     # -- drivers ---------------------------------------------------------
 
     @partial(jax.jit, static_argnums=(0, 2))
-    def _run_scan(self, st: EngineState, max_steps: int):
-        def body(carry, _):
-            return self._superstep(carry, True)
-        return jax.lax.scan(body, st, None, length=max_steps)
+    def _run_scan(self, st: EngineState, n_pad: int, max_steps):
+        """Traced driver: ``n_pad`` (static) is the pow2-padded scan
+        length (common.py ``scan_pad``), ``max_steps`` (traced) the
+        real budget — the shared ``padded_scan`` body computes and
+        discards the tail, so every budget in a pow2 bucket shares
+        one executable."""
+        return padded_scan(self._step_all, st, n_pad, max_steps)
+
+    def _decode_traces(self, ys) -> list:
+        """Per-world trace decode of batched scan output ([T, B]
+        leaves): one :class:`SuperstepTrace` per world, each holding
+        only the supersteps where that world actually fired."""
+        valid = np.asarray(ys.valid)
+        cols = [np.asarray(getattr(ys, f)) for f in
+                ("t", "fired_count", "fired_hash", "recv_count",
+                 "recv_hash", "sent_count", "sent_hash", "overflow")]
+        traces = []
+        for b in range(self.batch.B):
+            m = valid[:, b]
+            traces.append(SuperstepTrace.from_rows(
+                list(zip(*(c[m, b] for c in cols)))))
+        return traces
 
     def run(self, max_steps: int,
             state: Optional[EngineState] = None
             ) -> Tuple[EngineState, SuperstepTrace]:
-        """Execute up to ``max_steps`` supersteps; returns final state and
-        the trace of the supersteps that actually fired."""
+        """Execute up to ``max_steps`` supersteps; returns final state
+        and the trace of the supersteps that actually fired — batched
+        engines return a **list** of per-world traces."""
         st = state if state is not None else self.init_state()
-        final, ys = self._run_scan(st, max_steps)
+        final, ys = self._run_scan(st, _scan_pad(max_steps),
+                                   jnp.asarray(max_steps, jnp.int64))
         ys = jax.device_get(ys)
+        if self.batch is not None:
+            return final, self._decode_traces(ys)
         m = np.asarray(ys.valid)
         rows = list(zip(
             np.asarray(ys.t)[m], np.asarray(ys.fired_count)[m],
@@ -923,15 +1081,9 @@ class JaxEngine:
         # different budgets reuses one compiled executable
         start_steps = st.steps  # max_steps is per-call, same as run()
         max_steps = jnp.asarray(max_steps, jnp.int64)
-
-        def cond(carry):
-            nxt = self.comm.all_min(self._next_event(carry))
-            return (nxt < NEVER) & (carry.steps - start_steps < max_steps)
-
-        def body(carry):
-            return self._superstep(carry, False)[0]
-
-        return jax.lax.while_loop(cond, body, st)
+        return jax.lax.while_loop(
+            self._while_cond_fn(start_steps, max_steps),
+            self._while_body_fn(start_steps, max_steps), st)
 
     def run_quiet(self, max_steps: int,
                   state: Optional[EngineState] = None) -> EngineState:

@@ -32,12 +32,13 @@ from ...core.scenario import Scenario
 from ...net.delays import LinkModel
 from ...parallel.mesh import (AxisName, Mesh, MeshComm,
                               ShardedDriver, axis_size, make_mesh)
+from .batched import BatchSpec
 from .common import group_rank
 from .edge_engine import EdgeEngine, EdgeState
 from .engine import EngineState, JaxEngine
 
-__all__ = ["MeshComm", "ShardedEdgeEngine", "ShardedEngine",
-           "ShardedFusedSparseEngine", "make_mesh"]
+__all__ = ["MeshComm", "ShardedBatchedEngine", "ShardedEdgeEngine",
+           "ShardedEngine", "ShardedFusedSparseEngine", "make_mesh"]
 
 
 class ShardedEdgeEngine(ShardedDriver, EdgeEngine):
@@ -172,6 +173,81 @@ class ShardedEngine(ShardedDriver, JaxEngine):
             # (record_events=0 sharded: zero-size, replicated)
             ev_time=P(), ev_meta=P(), ev_count=P(),
         )
+
+
+class ShardedBatchedEngine(ShardedDriver, JaxEngine):
+    """The fleet over a mesh: the **world axis** sharded, nodes
+    device-local. Each device runs ``B / D`` complete worlds — the
+    embarrassingly-parallel layout the replica-sweep workload wants
+    (worlds are independent, so the superstep needs NO collectives;
+    the only mesh-wide reduction is run_quiet's "any world still
+    active" liveness check). Contrast :class:`ShardedEngine`, which
+    shards the *node* axis of one world and pays an ``all_to_all``
+    per superstep — compose them by passing this engine a mesh axis
+    of a multi-axis mesh when single-world capacity AND fleet width
+    are both needed.
+
+    The batch exactness law is unchanged: world b sliced out of the
+    gathered state is bit-identical to the solo run with that world's
+    seed/link (tests/test_world_batch.py runs this on the virtual
+    8-device CPU mesh)."""
+
+    def __init__(self, scenario: Scenario, link: LinkModel,
+                 mesh: Mesh, *, batch: BatchSpec,
+                 axis: AxisName = "worlds", seed: int = 0,
+                 window=1, route_cap: Optional[int] = None,
+                 lint: str = "warn") -> None:
+        super().__init__(scenario, link, seed=seed, window=window,
+                         route_cap=route_cap, lint=lint, batch=batch)
+        if batch is None:
+            raise ValueError(
+                "ShardedBatchedEngine shards the world axis; it needs "
+                "a BatchSpec (for a single sharded world use "
+                "ShardedEngine)")
+        self.mesh = mesh
+        self.axis = axis
+        D = axis_size(mesh, axis)
+        if batch.B % D:
+            raise ValueError(
+                f"batch of {batch.B} worlds not divisible over "
+                f"{D} devices (worlds are whole — pad the seed list "
+                "or shrink the mesh)")
+        #: worlds resident per device
+        self.worlds_local = batch.B // D
+        # comm stays LocalComm: every world's nodes live on one device
+
+    # -- world-axis sharding ---------------------------------------------
+
+    def _state_specs(self, st: EngineState) -> EngineState:
+        # uniform rule: every leaf's LEADING axis is the world axis
+        ax = self.axis
+        return jax.tree.map(
+            lambda x: P(ax, *([None] * (x.ndim - 1))), st)
+
+    def _trace_spec(self) -> P:
+        # scan-trace leaves are [T, B_local] per device: gather the
+        # world axis, not the (nonexistent) replication
+        return P(None, self.axis)
+
+    def _step_all(self, st, with_trace: bool):
+        # this device's slice of the world context (seed words + link
+        # parameter vectors): closure constants are replicated into
+        # the shard_map body, so slice by mesh position — the same
+        # pattern as MeshComm.local_rows
+        Bl = self.worlds_local
+        off = jax.lax.axis_index(self.axis).astype(jnp.int32) \
+            * jnp.int32(Bl)
+        def sl(v):
+            return jax.lax.dynamic_slice_in_dim(v, off, Bl, axis=0)
+        return self._vstep(st, sl(self._s0v), sl(self._s1v),
+                           {k: sl(v) for k, v in self._lpv.items()},
+                           with_trace)
+
+    def _any_world(self, x):
+        # liveness must be mesh-wide: one device's worlds finishing
+        # must not stop the others' (int32 psum — bool all-reduce
+        # does not lower on the TPU path, see MeshComm.all_min)
+        return jax.lax.psum(x.astype(jnp.int32), self.axis) > 0
 
 
 class ShardedFusedSparseEngine(ShardedEngine):

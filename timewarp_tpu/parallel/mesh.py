@@ -28,7 +28,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..interp.jax_engine.common import LocalComm
+from ..interp.jax_engine.common import LocalComm, padded_scan
 
 try:  # newer jax exports shard_map at the top level
     _shard_map = jax.shard_map
@@ -178,35 +178,35 @@ class ShardedDriver:
             lambda x, s: jax.device_put(x, NamedSharding(self.mesh, s)),
             st, specs)
 
+    def _trace_spec(self) -> P:
+        """PartitionSpec of one scan-trace leaf: replicated for the
+        node-sharded engines (trace scalars are already psum'd mesh-
+        wide); the world-sharded engine overrides (per-world rows live
+        on the world's device)."""
+        return P()
+
     @partial(jax.jit, static_argnums=(0, 2))
-    def _run_scan(self, st, max_steps: int):
+    def _run_scan(self, st, n_pad: int, max_steps):
+        # pow2-padded scan length + masked tail, the shared
+        # compile-reuse contract (jax_engine/common.py padded_scan)
         specs = self._state_specs(st)
 
-        def body(s):
-            def step(carry, _):
-                return self._superstep(carry, True)
-            return jax.lax.scan(step, s, None, length=max_steps)
+        def body(s, ms):
+            return padded_scan(self._step_all, s, n_pad, ms)
 
-        return _smap(body, self.mesh, (specs,), (specs, P()))(st)
+        return _smap(body, self.mesh, (specs, P()),
+                     (specs, self._trace_spec()))(st, max_steps)
 
     @partial(jax.jit, static_argnums=(0,))
     def _run_while(self, st, max_steps):
-        from ..core.scenario import NEVER
-
         specs = self._state_specs(st)
         max_steps = jnp.asarray(max_steps, jnp.int64)
 
         def body_fn(s, ms):
             start_steps = s.steps
-
-            def cond(carry):
-                nxt = self.comm.all_min(self._next_event(carry))
-                return (nxt < NEVER) & (carry.steps - start_steps < ms)
-
-            def body(carry):
-                return self._superstep(carry, False)[0]
-
-            return jax.lax.while_loop(cond, body, s)
+            return jax.lax.while_loop(
+                self._while_cond_fn(start_steps, ms),
+                self._while_body_fn(start_steps, ms), s)
 
         return _smap(body_fn, self.mesh, (specs, P()),
                      specs)(st, max_steps)

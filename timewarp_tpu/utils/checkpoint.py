@@ -29,6 +29,16 @@ with ``JaxEngine``, so a checkpoint saved under either resumes under
 the other (tests/test_fused_sparse.py) — unlike the fused *ring*
 engine, whose packed layout needs its own ``to_edge_state`` /
 ``from_edge_state`` pair (fused_ring.py).
+
+Batched (multi-world) states need nothing special either: the world
+axis is a leading dim on every leaf, the template (the batched
+engine's ``init_state()``) carries the same shapes, and the widening
+rule above is shape-generic (tests/test_checkpoint.py batched leg).
+A solo checkpoint will NOT load into a batched template (or vice
+versa, or across different world counts) — the shape check fails
+loudly, which is correct: there is no meaningful world-axis
+migration. Store the seed fleet in ``meta`` (the CLI does) so resume
+can refuse a mismatched fleet before the RNG streams diverge.
 """
 
 from __future__ import annotations

@@ -22,6 +22,13 @@ general engine's. Configs outside the fused engine's scope (non-1024
 node counts, droppy links, route_cap, ...) record the constructor's
 refusal reason instead — the column is never silently absent.
 
+Round 7 adds a **batched column**: the batch exactness law
+(engine.py ``batch=BatchSpec``) on the artifact hardware — each
+general-engine config runs a 3-world batched fleet (seeds 0/1/2) and
+every world's trace is compared bit-for-bit against the solo run with
+that seed (world 0 against the solo column itself). Engines without
+the world axis record the refusal, never a silent absence.
+
 Usage: ``python tools/parity_tpu.py`` (writes PARITY_TPU.json at the
 repo root). Exits nonzero on any trace mismatch. If no accelerator is
 attached the artifact records the platform actually used.
@@ -54,7 +61,8 @@ def trace_sha(tr) -> str:
 
 def main() -> int:
     from timewarp_tpu.interp.jax_engine.edge_engine import EdgeEngine
-    from timewarp_tpu.interp.jax_engine.engine import JaxEngine
+    from timewarp_tpu.interp.jax_engine.engine import (BatchSpec,
+                                                       JaxEngine)
     from timewarp_tpu.interp.jax_engine.fused_sparse import \
         FusedSparseEngine
     from timewarp_tpu.interp.ref.superstep import SuperstepOracle
@@ -181,14 +189,52 @@ def main() -> int:
                 out["ok"] = False
             entry["fused_sparse"] = fent
 
+        # batched multi-world column (round 7): the batch exactness
+        # law on the artifact hardware — every world of a 3-world
+        # fleet sliced against the solo run with that world's seed.
+        # World 0 shares the solo column's seed=0, so its trace must
+        # equal `etrace` itself.
+        if eng_cls is JaxEngine:
+            batched = JaxEngine(sc, link, batch=BatchSpec(
+                seeds=(0, 1, 2)), **ekw)
+            _, btr = batched.run(steps)
+            bent = {"supported": True,
+                    "sha": [trace_sha(t) for t in btr]}
+            try:
+                assert_traces_equal(etrace, btr[0],
+                                    f"solo-{platform}",
+                                    f"batched-w0-{platform}")
+                for b in (1, 2):
+                    _, strc = JaxEngine(sc, link, seed=b,
+                                        **ekw).run(steps)
+                    assert_traces_equal(strc, btr[b],
+                                        f"solo-seed{b}-{platform}",
+                                        f"batched-w{b}-{platform}")
+                bent["equal"] = True
+            except TraceMismatch as e:
+                bent["equal"] = False
+                bent["mismatch"] = str(e)
+                out["ok"] = False
+            entry["batched"] = bent
+        else:
+            entry["batched"] = {
+                "supported": False,
+                "reason": "engine has no world axis (batch=BatchSpec "
+                          "is the general engine's lever)"}
+
         out["configs"][name] = entry
         fus = entry["fused_sparse"]
         fused_word = ("fused-sparse out of scope" if not fus["supported"]
                       else "fused-sparse "
                       + ("OK" if fus["equal"] else "MISMATCH"))
+        bat = entry["batched"]
+        bat_word = ("batched out of scope" if not bat["supported"]
+                    else "batched "
+                    + ("OK" if bat["equal"] else "MISMATCH"))
         print(f"{name}: {'OK' if entry['equal'] else 'MISMATCH'} "
               f"({entry['supersteps']} supersteps, "
-              f"{entry['delivered']} delivered, {fused_word})")
+              f"{entry['delivered']} delivered, {fused_word}, "
+              f"{bat_word})")
 
     if "--self-check" not in sys.argv:
         root = os.path.dirname(os.path.dirname(os.path.abspath(
