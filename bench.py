@@ -326,6 +326,91 @@ def bench_gossip_100k_b8(n, steps):
             f"delivered-messages/sec/chip @{n} nodes", delivered / dt)
 
 
+def bench_gossip_100k_chaos(n, steps):
+    """Monte-Carlo chaos study: 8 gossip worlds, 8 DISTINCT fault
+    schedules (reset crashes + a mid-run partition + a degradation
+    window per world — faults/), one batched engine. Steady-state
+    mongering (not the one-shot wave) so re-infection after heals is
+    guaranteed and convergence is a meaningful property. Gated
+    in-bench by the chaos-fleet exactness law (world-b slice ≡ solo
+    run with that world's schedule, bit-for-bit) AND a robustness
+    property check (deliveries continue after every world's faults
+    clear; every world converges to full infection) before the
+    measured run counts. Reports aggregate delivered-msg/s/chip plus
+    per-world route_drop / fault_dropped in the JSON line (the
+    never-silent contract on the world axis)."""
+    import numpy as np
+    from timewarp_tpu.core.scenario import NEVER
+    from timewarp_tpu.faults import (FaultFleet, FaultSchedule,
+                                     LinkWindow, NodeCrash, Partition,
+                                     eventually_delivered)
+    from timewarp_tpu.interp.jax_engine.engine import (BatchSpec,
+                                                       JaxEngine)
+    from timewarp_tpu.models.gossip import gossip
+    from timewarp_tpu.net.delays import Quantize, UniformDelay
+
+    n = n or 100_000
+    B = 8
+    sc = gossip(n, fanout=1, think_us=1_000, gossip_interval=1_000,
+                end_us=300_000, steady=True, mailbox_cap=8)
+    link = Quantize(UniformDelay(500, 4_500), 1_000)
+    half = n // 2
+    heal_us = 0
+    scheds = []
+    for b in range(B):
+        part_end = 70_000 + 2_000 * b
+        crash_up = 60_000 + 5_000 * b
+        # the LAST fault to clear in this world: the second crash
+        # window runs to crash_up + 10 ms
+        heal_us = max(heal_us, part_end, crash_up + 10_000)
+        scheds.append(FaultSchedule((
+            NodeCrash((7 * b + 3) % n, 20_000, crash_up,
+                      reset_state=True),
+            NodeCrash((11 * b + half + 5) % n, 30_000,
+                      crash_up + 10_000),
+            Partition((tuple(range(half)), tuple(range(half, n))),
+                      25_000, part_end),
+            LinkWindow(None, None, 80_000, 120_000,
+                       scale=2.0 + 0.25 * b),
+        )))
+    fleet = FaultFleet(tuple(scheds))
+    spec = BatchSpec(seeds=tuple(range(B)))
+    engine = JaxEngine(sc, link, window="auto", batch=spec,
+                       faults=fleet)
+    # gate 1: the chaos-fleet exactness law on the bench hardware
+    _assert_batched_exact(engine, lambda b: JaxEngine(
+        sc, link, seed=spec.seeds[b], window=engine.window,
+        faults=fleet.world_schedule(b)))
+    # gate 2: robustness properties on a traced confirmation run —
+    # traffic must still flow after every world's faults clear
+    _, traces = engine.run(192)
+    for b, tr in enumerate(traces):
+        assert eventually_delivered(tr, heal_us), \
+            f"world {b}: no deliveries after its faults healed"
+    delivered, dt, fin = _measure(engine, steps or (1 << 20))
+    # quiescence + parity-regime counters + convergence, per world
+    nxt = jax.vmap(engine._next_event)(fin)
+    assert int(np.asarray(jax.device_get(nxt)).min()) >= NEVER, \
+        "chaos fleet did not quiesce inside the step budget"
+    assert int(np.asarray(jax.device_get(fin.short_delay)).sum()) == 0, \
+        "windowed run left the exact regime"
+    route_drop = np.asarray(jax.device_get(fin.route_drop))
+    fault_dropped = np.asarray(jax.device_get(fin.fault_dropped))
+    assert int(route_drop.sum()) == 0, "routing dropped messages"
+    hops = np.asarray(jax.device_get(fin.states["hop"]))
+    for b in range(B):
+        assert int(fault_dropped[b]) > 0, \
+            f"world {b}: chaos schedule never bit (fault_dropped=0)"
+        missed = int((hops[b] < 0).sum())
+        assert missed <= max(n // 500, 8), \
+            f"world {b} did not converge: {missed} nodes uninfected"
+    extra = {"route_drop": route_drop.tolist(),
+             "fault_dropped": fault_dropped.tolist()}
+    return (f"gossip steady-state chaos fleet (batched x{B}, per-world "
+            f"fault schedules) aggregate delivered-messages/sec/chip "
+            f"@{n} nodes", delivered / dt, extra)
+
+
 def bench_praos_1m_b4(n, steps):
     """Praos as a 4-world fleet sweeping BOTH seed and link model per
     world (lognormal median 18/20/22/24 ms — a Monte-Carlo link study
@@ -441,6 +526,7 @@ CONFIGS = {
     "gossip_100k": bench_gossip_100k,
     "gossip_100k_fused": bench_gossip_100k_fused,
     "gossip_100k_b8": bench_gossip_100k_b8,
+    "gossip_100k_chaos": bench_gossip_100k_chaos,
     "gossip_steady_1m": bench_gossip_steady_1m,
     "praos_1m": bench_praos_1m,
     "praos_1m_fused": bench_praos_1m_fused,
@@ -457,6 +543,7 @@ SMOKE = {
     "gossip_100k": (2048, 1 << 14),
     "gossip_100k_fused": (2048, 1 << 14),
     "gossip_100k_b8": (1024, 1 << 14),
+    "gossip_100k_chaos": (1024, 1 << 14),
     "gossip_steady_1m": (4096, 16),
     "praos_1m": (2048, 24),
     "praos_1m_fused": (2048, 24),
@@ -514,11 +601,23 @@ def smoke() -> None:
     _lint_gate()
     for cfg, (n, steps) in SMOKE.items():
         t0 = time.perf_counter()
-        metric, _ = CONFIGS[cfg](n, steps)
+        metric, _rate, extra = _run_config(cfg, n, steps)
         print(json.dumps({
             "config": cfg, "metric": metric, "smoke": True,
             "ok": True, "seconds": round(time.perf_counter() - t0, 1),
+            **extra,
         }), flush=True)
+
+
+def _run_config(cfg, n, steps):
+    """Run one config; normalize its return to (metric, rate, extra).
+    ``extra`` is a dict of additional JSON-line fields (the chaos
+    config reports per-world route_drop / fault_dropped — the
+    never-silent contract on the world axis)."""
+    res = CONFIGS[cfg](n, steps)
+    metric, rate = res[0], res[1]
+    extra = res[2] if len(res) > 2 else {}
+    return metric, rate, extra
 
 
 def main() -> None:
@@ -548,12 +647,13 @@ def main() -> None:
     steps = int(os.environ.get("TW_BENCH_STEPS", 0)) or None
     global _REPS
     _REPS = reps  # _measure repeats the window; gates/compiles run once
-    metric, rate = CONFIGS[cfg](n, steps)
+    metric, rate, extra = _run_config(cfg, n, steps)
     out = {
         "metric": metric,
         "value": round(rate, 1),  # the median-of-K rate (K = --reps)
         "unit": "msg/s",
         "vs_baseline": round(rate / 1e8, 4),
+        **extra,
     }
     if reps > 1:
         out["reps"] = reps

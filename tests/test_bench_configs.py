@@ -16,15 +16,20 @@ def test_bench_config_runs(cfg):
     n = {"token_ring_dense": 512, "token_ring_dense_xla": 512,
          "token_ring_observer": 256,
          "gossip_100k": 512, "gossip_100k_fused": 2048,
-         "gossip_100k_b8": 512,
+         "gossip_100k_b8": 512, "gossip_100k_chaos": 512,
          "gossip_steady_1m": 512,
          "praos_1m": 512, "praos_1m_fused": 2048,
          "praos_1m_b4": 512}[cfg]
     # the gossip waves run to quiescence and assert they got there
     steps = 20_000 if cfg.startswith("gossip_100k") else 48
-    metric, rate = bench.CONFIGS[cfg](n, steps)
+    metric, rate, extra = bench._run_config(cfg, n, steps)
     assert rate > 0
     assert str(n) in metric
+    if cfg == "gossip_100k_chaos":
+        # the chaos config's never-silent world-axis counters ride
+        # the JSON line: every world's schedule must actually bite
+        assert all(v > 0 for v in extra["fault_dropped"])
+        assert all(v == 0 for v in extra["route_drop"])
 
 
 def test_bench_main_prints_one_json_line(capsys, monkeypatch):

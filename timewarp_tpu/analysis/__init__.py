@@ -27,6 +27,7 @@ import logging
 
 from ..core.scenario import Scenario
 from .capacity import lint_capacity, worst_case_fan_in
+from .fault_lint import check_faults, lint_fault_schedule
 from .jaxpr_lint import HOST_ESCAPE_PRIMITIVES, lint_step_jaxpr
 from .probes import probe_commutative_inbox
 from .program_lint import (GENERATOR_COMBINATORS, lint_module_programs,
@@ -38,6 +39,7 @@ __all__ = [
     "Finding", "LintReport", "LintError",
     "ERROR", "WARNING", "INFO",
     "lint_scenario", "check_scenario", "LINT_MODES",
+    "lint_fault_schedule", "check_faults",
     "lint_step_jaxpr", "lint_capacity", "worst_case_fan_in",
     "probe_commutative_inbox",
     "lint_program", "lint_source", "lint_module_programs",

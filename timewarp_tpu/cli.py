@@ -64,20 +64,28 @@ def build_batch(args):
 
 #: the --link grammar, named in every parse error
 LINK_GRAMMAR = ("fixed:D | uniform:LO:HI | lognormal:MEDIAN:SIGMA | "
-                "drop:P:<inner> | quantize:Q:<inner>  "
-                "(D/LO/HI/MEDIAN/Q integer µs; P/SIGMA float)")
+                "drop:P:<inner> | quantize:Q:<inner> | never  "
+                "(D/LO/HI/MEDIAN/Q integer µs; P/SIGMA float; "
+                "never = drop probability 1, the old NeverConnected)")
 
 
 def parse_link(spec: str):
     """``fixed:D`` | ``uniform:LO:HI`` | ``lognormal:MEDIAN:SIGMA`` —
-    optionally wrapped ``drop:P:<inner>`` and/or ``quantize:Q:<inner>``.
+    optionally wrapped ``drop:P:<inner>`` and/or ``quantize:Q:<inner>``;
+    ``never`` is the fully-severed link (``WithDrop(..,
+    NEVER_CONNECTED)`` ≙ the reference's ``NeverConnected`` outcome).
     Malformed specs die with a message naming the grammar, never a raw
     IndexError/ValueError."""
-    from .net.delays import (FixedDelay, LogNormalDelay, Quantize,
-                             UniformDelay, WithDrop)
+    from .net.delays import (NEVER_CONNECTED, FixedDelay, LogNormalDelay,
+                             Quantize, UniformDelay, WithDrop)
     parts = spec.split(":")
     kind = parts[0]
     try:
+        if kind == "never":
+            if len(parts) != 1:
+                raise ValueError("never takes no parameters (every "
+                                 "message is dropped)")
+            return WithDrop(FixedDelay(1), NEVER_CONNECTED)
         if kind == "drop":
             if len(parts) < 3 or not parts[2]:
                 raise ValueError("drop needs a probability and an "
@@ -137,8 +145,28 @@ def build_scenario(args):
     raise SystemExit(f"unknown scenario {args.scenario!r}")
 
 
+#: engines that can run a fault schedule (faults/: scheduled chaos)
+FAULT_ENGINES = ("oracle", "general", "edge", "sharded-batched")
+
+
+def build_faults(args):
+    """The fault schedule from --faults, or None. A batched run
+    replicates the one schedule to every world (per-world schedules
+    are the library FaultFleet API)."""
+    if args.faults is None:
+        return None
+    from .faults.schedule import parse_faults
+    return parse_faults(args.faults)
+
+
 def build_engine(args, sc, link):
     batch = build_batch(args)
+    faults = build_faults(args)
+    if faults is not None and args.engine not in FAULT_ENGINES:
+        raise SystemExit(
+            f"--faults runs on {', '.join(FAULT_ENGINES)}; "
+            f"{args.engine} has no fault masks wired into its "
+            "superstep (the fused kernels bypass the mask points)")
     # never-silent: reject knobs an engine would ignore rather than
     # letting cross-engine comparisons diverge mysteriously
     if batch is not None and args.engine not in BATCH_ENGINES:
@@ -185,20 +213,21 @@ def build_engine(args, sc, link):
     if args.engine == "oracle":
         from .interp.ref.superstep import SuperstepOracle
         return SuperstepOracle(sc, link, seed=args.seed,
-                               window=args.window, lint=args.lint)
+                               window=args.window, lint=args.lint,
+                               faults=faults)
     if args.engine == "general":
         from .interp.jax_engine.engine import JaxEngine
         return JaxEngine(sc, link, seed=args.seed, window=args.window,
                          route_cap=args.route_cap,
                          record_events=args.record_events,
-                         lint=args.lint, batch=batch)
+                         lint=args.lint, batch=batch, faults=faults)
     if args.engine == "sharded-batched":
         from .interp.jax_engine.sharded import (ShardedBatchedEngine,
                                                 make_mesh)
         return ShardedBatchedEngine(
             sc, link, make_mesh(args.devices, axis="worlds"),
             batch=batch, seed=args.seed, window=args.window,
-            route_cap=args.route_cap, lint=args.lint)
+            route_cap=args.route_cap, lint=args.lint, faults=faults)
     if args.engine == "fused-sparse":
         from .interp.jax_engine.fused_sparse import FusedSparseEngine
         kw = {} if args.max_batch is None else {
@@ -210,7 +239,7 @@ def build_engine(args, sc, link):
     if args.engine == "edge":
         from .interp.jax_engine.edge_engine import EdgeEngine
         return EdgeEngine(sc, link, seed=args.seed, cap=args.edge_cap,
-                          lint=args.lint)
+                          lint=args.lint, faults=faults)
     if args.engine in ("sharded", "sharded-edge", "sharded-fused"):
         from .interp.jax_engine.sharded import (
             ShardedEdgeEngine, ShardedEngine,
@@ -279,13 +308,16 @@ def _m(name):
 
 
 def lint_sweep(families=None, *, nodes: int = 64, probe: bool = True,
-               seed: int = 0):
+               seed: int = 0, faults=None):
     """The shared sanitizer sweep behind both ``timewarp-tpu lint``
     and bench's pre-run gate: returns ``(subjects, LintReport)``. A
     subject that fails to build or import becomes a TW000 error
-    finding — one broken model never kills the sweep."""
+    finding — one broken model never kills the sweep. ``faults``
+    (a FaultSchedule) additionally runs the TW5xx fault lints against
+    every swept scenario."""
     from .analysis import (ERROR, Finding, LintReport,
-                           lint_module_programs, lint_scenario)
+                           lint_fault_schedule, lint_module_programs,
+                           lint_scenario)
     scenarios, modules = lint_targets(families, nodes=nodes)
     report = LintReport()
     subjects = 0
@@ -300,6 +332,8 @@ def lint_sweep(families=None, *, nodes: int = 64, probe: bool = True,
                     f"scenario failed to build under lint: {e!r}"))
                 continue
             report.extend(lint_scenario(sc, probe=probe, seed=seed))
+            if faults is not None:
+                report.extend(lint_fault_schedule(faults, sc))
     for fam, mods in modules.items():
         for mod in mods:
             subjects += 1
@@ -334,12 +368,20 @@ def lint_main(argv) -> int:
                    help="probe permutation seed")
     p.add_argument("--json", action="store_true",
                    help="one JSON report line instead of findings text")
+    p.add_argument("--faults", default=None,
+                   help="also lint this fault schedule (the --faults "
+                        "run grammar) against every swept scenario — "
+                        "the TW5xx rules (docs/faults.md)")
     args = p.parse_args(argv)
 
+    faults = None
+    if args.faults:
+        from .faults.schedule import parse_faults
+        faults = parse_faults(args.faults)
     subjects, report = lint_sweep(args.families or None,
                                   nodes=args.nodes,
                                   probe=not args.no_probe,
-                                  seed=args.seed)
+                                  seed=args.seed, faults=faults)
 
     if args.json:
         print(json.dumps({"subjects": subjects, **report.to_json()}))
@@ -370,7 +412,18 @@ def main(argv=None) -> int:
                    help="max supersteps to run")
     p.add_argument("--link", default="uniform:1000:5000",
                    help="fixed:D | uniform:LO:HI | lognormal:MED:SIGMA"
-                        " | drop:P:<inner> | quantize:Q:<inner>")
+                        " | drop:P:<inner> | quantize:Q:<inner> | "
+                        "never (stationary loss: drop:P wraps any "
+                        "inner model with i.i.d. loss probability P; "
+                        "never severs the link entirely — the old "
+                        "NeverConnected)")
+    p.add_argument("--faults", default=None,
+                   help="deterministic fault schedule (faults/): "
+                        "';'-separated events, e.g. "
+                        "\"crash:3:5s:9s:reset; partition:0-3|4-7:2s:4s;"
+                        " degrade:all:all:1s:2s:4.0:10ms; skew:2:250\" "
+                        "— crash/restart windows, partitions, link "
+                        "degradation, clock skew; see docs/faults.md")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--batch", type=int, default=None,
                    help="world count B: run B independent emulations "
@@ -448,6 +501,8 @@ def main(argv=None) -> int:
         trace = engine.run(args.steps)
         final_info = {"overflow": engine.overflow_total,
                       "bad_dst": engine.bad_dst_total}
+        if args.faults:
+            final_info["fault_dropped"] = engine.fault_dropped_total
     else:
         import numpy as np
         batched = getattr(engine, "batch", None)
@@ -456,6 +511,15 @@ def main(argv=None) -> int:
             from .utils.checkpoint import load_state
             state, ck_meta = load_state(args.resume, engine.init_state(),
                                         expect_meta={"scenario": sc.name})
+            if ck_meta.get("faults") != args.faults:
+                # the restart ledger (and every masked decision so
+                # far) is schedule-specific: resuming under a
+                # different schedule would be neither run
+                raise SystemExit(
+                    f"checkpoint was written under --faults "
+                    f"{ck_meta.get('faults')!r}; resuming under "
+                    f"{args.faults!r} would diverge — pass the "
+                    "matching schedule")
             if batched is not None:
                 if ck_meta.get("seeds") != list(batched.seeds):
                     # per-world RNG streams are part of the state:
@@ -477,20 +541,30 @@ def main(argv=None) -> int:
             meta = {"scenario": sc.name, "seed": args.seed}
             if batched is not None:
                 meta["seeds"] = list(batched.seeds)
+            if args.faults:
+                meta["faults"] = args.faults
             save_state(args.save, final, meta=meta)
         if batched is not None:
             # per-world counters: the whole point of the fleet is that
-            # worlds differ — aggregate in your own tooling, not here
+            # worlds differ — aggregate in your own tooling, not here.
+            # route_drop / fault_dropped ride along per WORLD (the
+            # never-silent contract extended to the world axis): a
+            # lossy world must not hide behind fleet aggregates
             final_info = {
                 "worlds": batched.B,
                 "seeds": list(batched.seeds),
                 "overflow": np.asarray(final.overflow).tolist(),
+                "route_drop": np.asarray(final.route_drop).tolist(),
+                "fault_dropped":
+                    np.asarray(final.fault_dropped).tolist(),
                 "steps": np.asarray(final.steps).tolist(),
                 "virtual_time_us": np.asarray(final.time).tolist()}
         else:
             final_info = {"overflow": int(final.overflow),
                           "steps": int(final.steps),
                           "virtual_time_us": int(final.time)}
+            if args.faults:
+                final_info["fault_dropped"] = int(final.fault_dropped)
 
     if args.events_csv:
         import csv

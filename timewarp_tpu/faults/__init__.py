@@ -1,0 +1,33 @@
+"""Deterministic chaos: scheduled fault injection inside the superstep.
+
+The reference promised "manually controlled network nastiness"
+(``Delays`` / ``ConnectionOutcome``, examples/token-ring/Main.hs:73-77);
+:mod:`timewarp_tpu.net.delays` revives its *stationary* half — per-
+message laws that never change over emulated time. This package adds
+the **time-varying** half: crash/restart a node with state loss,
+partition the network for a window, degrade a set of links for a
+burst, skew a node's clock — all as a static, declarative
+:class:`FaultSchedule` applied as pure jittable masks inside every
+superstep, so the same schedule runs bit-for-bit under the host
+oracle, the XLA engines, and a ``vmap``-ed multi-world fleet
+(:class:`FaultFleet`: B worlds, B schedules, one chip — the
+Monte-Carlo chaos study the ROADMAP's north star asks for).
+
+Semantics are defined once (docs/faults.md) and pinned by the same
+law every other feature answers to: oracle ≡ engine trace parity, and
+chaos-fleet world-slice exactness (tests/test_zfault_parity.py).
+"""
+
+from .properties import (TraceRow, converged, eventually_delivered,
+                         no_fire_while_down)
+from .schedule import (FAULT_GRAMMAR, ClockSkew, FaultFleet,
+                       FaultSchedule, FaultTables, LinkWindow, NodeCrash,
+                       Partition, as_fleet, parse_faults)
+
+__all__ = [
+    "NodeCrash", "Partition", "LinkWindow", "ClockSkew",
+    "FaultSchedule", "FaultFleet", "FaultTables",
+    "parse_faults", "FAULT_GRAMMAR", "as_fleet",
+    "eventually_delivered", "converged", "no_fire_while_down",
+    "TraceRow",
+]

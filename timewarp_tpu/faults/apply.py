@@ -1,0 +1,166 @@
+"""Pure jittable fault masks: ``(FaultTables, virtual time) -> masks``.
+
+Every function here is elementwise/broadcast jax.numpy over the
+fixed-shape tables of :mod:`timewarp_tpu.faults.schedule` — no host
+control flow on traced values, so the same code runs inside the solo
+superstep, under ``vmap`` for a :class:`~timewarp_tpu.faults.schedule.
+FaultFleet` (tables carry a leading world axis), and under
+``shard_map`` (masks are per-node elementwise; node ids are global).
+Zero-row tables short-circuit at trace time (shapes are static), so an
+engine built without a given fault kind compiles the exact pre-fault
+program for that stage.
+
+The one piece of *state* faults need is ``restart_done: bool[C]`` —
+whether each crash row's injected restart firing has been consumed.
+Everything else is a pure function of the schedule and the clock
+(injecting restarts statelessly would re-fire a rebooted node whose
+window start the epoch has not yet crossed — windowed supersteps run
+per-node instants ahead of the epoch).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.scenario import NEVER
+
+__all__ = [
+    "defer_next", "restart_fire", "consume_restarts",
+    "cut_mask", "down_mask", "degrade", "skewed_step",
+]
+
+
+def _crash_active(ft):
+    return ft.crash_up > ft.crash_down            # [C] (inert rows off)
+
+
+def defer_next(ft, node_ids, node_next, restart_done):
+    """Crash-adjusted next-event times: an event inside the node's
+    down window slides to ``t_up`` (single pass — overlapping windows
+    per node are a TW502 lint error), and every unconsumed
+    ``reset_state`` row injects a restart firing at exactly ``t_up``
+    (the reboot event the reset anchors to)."""
+    if ft.crash_node.shape[0] == 0:
+        return node_next
+    m = (ft.crash_node[:, None] == node_ids[None, :]) \
+        & _crash_active(ft)[:, None]                        # [C, N]
+    x = node_next[None, :]
+    inwin = m & (ft.crash_down[:, None] <= x) & (x < ft.crash_up[:, None])
+    deferred = jnp.max(jnp.where(inwin, ft.crash_up[:, None], x),
+                       axis=0)
+    pend = m & ft.crash_reset[:, None] & ~restart_done[:, None]
+    inject = jnp.min(jnp.where(pend, ft.crash_up[:, None],
+                               jnp.int64(NEVER)), axis=0)
+    return jnp.minimum(deferred, inject)
+
+
+def restart_fire(ft, fire, now_vec, node_ids, restart_done):
+    """The restart firings happening *this* superstep: a fired node
+    whose instant equals an unconsumed reset row's ``t_up``. Returns
+    ``(reset_now bool[N], purge_before int64[N])`` — reset the node's
+    state before its step runs, and purge mailbox entries with deliver
+    time < ``purge_before`` (memory the reboot lost; 0 = none)."""
+    n = node_ids.shape[0]
+    if ft.crash_node.shape[0] == 0:
+        return (jnp.zeros((n,), bool), jnp.zeros((n,), jnp.int64))
+    m = (ft.crash_node[:, None] == node_ids[None, :]) \
+        & _crash_active(ft)[:, None]
+    hit = m & ft.crash_reset[:, None] & ~restart_done[:, None] \
+        & fire[None, :] & (now_vec[None, :] == ft.crash_up[:, None])
+    reset_now = jnp.any(hit, axis=0)
+    purge_before = jnp.max(
+        jnp.where(hit, ft.crash_down[:, None], jnp.int64(0)), axis=0)
+    return reset_now, purge_before
+
+
+def consume_restarts(ft, fire, now_vec, node_ids, restart_done):
+    """``restart_done`` after this superstep: a row is consumed when
+    its node fires at exactly its ``t_up`` (the injected restart — or
+    a coincident legitimate event; either way the reboot happened)."""
+    if ft.crash_node.shape[0] == 0:
+        return restart_done
+    m = (ft.crash_node[:, None] == node_ids[None, :]) \
+        & _crash_active(ft)[:, None]
+    hit = m & ft.crash_reset[:, None] & fire[None, :] \
+        & (now_vec[None, :] == ft.crash_up[:, None])
+    return restart_done | jnp.any(hit, axis=1)
+
+
+def _flat(*xs):
+    """Broadcast operands to a common shape and flatten — the mask
+    bodies below work on 1-D lanes, callers pass any (mutually
+    broadcastable) rank: [S] message lanes, [M, N] outbox planes,
+    scalar times against [N] node vectors."""
+    bs = jnp.broadcast_arrays(*(jnp.asarray(x) for x in xs))
+    return bs[0].shape, tuple(b.reshape(-1) for b in bs)
+
+
+def cut_mask(ft, src, dst, t_send):
+    """True where a message crosses a live partition cut: some
+    partition row active at the *send instant* puts src and dst in
+    different (non-absent) groups. ``src``/``dst`` are global node
+    ids; out-of-range values must be pre-masked by the caller (indices
+    are clipped here only for gather safety)."""
+    shape, (src, dst, t) = _flat(src, dst, t_send)
+    if ft.part_group.shape[0] == 0:
+        return jnp.zeros(shape, bool)
+    n = ft.part_group.shape[-1]
+    gs = ft.part_group[:, jnp.clip(src, 0, n - 1)]         # [Pn, S]
+    gd = ft.part_group[:, jnp.clip(dst, 0, n - 1)]
+    act = (ft.part_start[:, None] <= t[None, :]) \
+        & (t[None, :] < ft.part_end[:, None])
+    cut = act & (gs != gd) & (gs >= 0) & (gd >= 0)
+    return jnp.any(cut, axis=0).reshape(shape)
+
+
+def down_mask(ft, node, t):
+    """True where ``node`` is inside a crash window at time ``t`` —
+    the routing stage drops messages whose *deliver* time lands in the
+    destination's down window (the NIC is off)."""
+    shape, (node, t) = _flat(node, t)
+    if ft.crash_node.shape[0] == 0:
+        return jnp.zeros(shape, bool)
+    m = (ft.crash_node[:, None] == node[None, :]) \
+        & _crash_active(ft)[:, None]
+    win = (ft.crash_down[:, None] <= t[None, :]) \
+        & (t[None, :] < ft.crash_up[:, None])
+    return jnp.any(m & win, axis=0).reshape(shape)
+
+
+def degrade(ft, delay, src, dst, t_send):
+    """Apply every live link-degradation window to the sampled delays:
+    ``delay' = (delay * num) // den + extra`` for affected messages.
+    Rows compose in table order (a static Python loop — L is a shape).
+    Integer arithmetic throughout: bit-exact on every backend."""
+    L = ft.link_start.shape[0]
+    if L == 0:
+        return delay
+    shape, (delay, src, dst, t) = _flat(delay, src, dst, t_send)
+    n = ft.link_src.shape[-1]
+    sc = jnp.clip(src, 0, n - 1)
+    dc = jnp.clip(dst, 0, n - 1)
+    for i in range(L):
+        aff = (ft.link_start[i] <= t) & (t < ft.link_end[i]) \
+            & ft.link_src[i][sc] & ft.link_dst[i][dc]
+        delay = jnp.where(
+            aff, (delay * ft.link_num[i]) // ft.link_den[i]
+            + ft.link_add[i], delay)
+    return delay.reshape(shape)
+
+
+def skewed_step(step, skew):
+    """Wrap a scenario step so the node observes skewed time: ``now``
+    and (valid) inbox deliver times shift by ``skew[node]``; the
+    returned wake shifts back to true time (NEVER stays NEVER).
+    Engine internals — entropy keys, digests, fault windows, the
+    contract-#5 clamp — all stay on true time. The *same* wrapped
+    function runs under the oracle's vmap and the engines', so skewed
+    behavior cannot diverge between interpreters."""
+    def wrapped(state, inbox, now, node_id, key):
+        off = skew[node_id]
+        ib = inbox._replace(
+            time=jnp.where(inbox.valid, inbox.time + off, inbox.time))
+        st, out, wake = step(state, ib, now + off, node_id, key)
+        wake = jnp.where(wake >= NEVER, wake, wake - off)
+        return st, out, wake
+    return wrapped

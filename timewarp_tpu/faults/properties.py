@@ -1,0 +1,80 @@
+"""Robustness properties over traces and event streams.
+
+The small assertion vocabulary chaos tests and bench gates speak:
+does the protocol keep making progress after the faults clear? did
+anything fire while it was supposed to be down? Properties are
+deliberately simple host-side checks over the observables the
+framework already emits — :class:`~timewarp_tpu.trace.events.
+SuperstepTrace` rows (aggregate, always available) and per-event
+streams (``SuperstepOracle(record_events=True).events`` or the
+engine's device ring) when per-node resolution is needed.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Iterable, NamedTuple
+
+from ..trace.events import SuperstepTrace
+
+__all__ = ["TraceRow", "eventually_delivered", "converged",
+           "no_fire_while_down"]
+
+
+class TraceRow(NamedTuple):
+    """One superstep's aggregates, as handed to ``converged``
+    predicates."""
+    t: int
+    fired_count: int
+    fired_hash: int
+    recv_count: int
+    recv_hash: int
+    sent_count: int
+    sent_hash: int
+    overflow: int
+
+
+def _rows(trace: SuperstepTrace):
+    return (TraceRow(*trace.row(i)) for i in range(len(trace)))
+
+
+def eventually_delivered(trace: SuperstepTrace, after_t: int) -> bool:
+    """True iff some superstep at virtual time >= ``after_t`` delivers
+    at least one message — "traffic still flows after the faults
+    clear" (e.g. after a partition heals)."""
+    return any(r.t >= after_t and r.recv_count > 0 for r in _rows(trace))
+
+
+def converged(trace: SuperstepTrace,
+              pred: Callable[[TraceRow], bool]) -> bool:
+    """Eventually-always: there is a superstep from which ``pred``
+    holds for every remaining row (vacuously False on an empty
+    trace — a run that never fired converged to nothing)."""
+    rows = list(_rows(trace))
+    if not rows:
+        return False
+    ok_from = len(rows)
+    for i in range(len(rows) - 1, -1, -1):
+        if not pred(rows[i]):
+            break
+        ok_from = i
+    return ok_from < len(rows)
+
+
+def no_fire_while_down(events: Iterable[tuple], schedule) -> bool:
+    """True iff no ``("fire", t, node)`` event lands inside one of the
+    ``schedule``'s crash windows — the firing-suppression contract,
+    checked at per-node resolution over an event stream
+    (``SuperstepOracle(record_events=True).events`` or the engine
+    ring's decode)."""
+    windows = [(c.node, c.t_down, c.t_up) for c in schedule.crashes
+               if c.t_up > c.t_down]
+    if not windows:
+        return True
+    for ev in events:
+        if ev[0] != "fire":
+            continue
+        _, t, node = ev[0], ev[1], ev[2]
+        for k, d, u in windows:
+            if node == k and d <= t < u:
+                return False
+    return True

@@ -15,7 +15,26 @@ import numpy as np
 from ...ops.numeric import I32MAX, group_rank, thi, tlo, u32sum
 
 __all__ = ["LocalComm", "StepOut", "I32MAX", "group_rank", "u32sum",
-           "tlo", "thi", "padded_scan", "scan_pad"]
+           "tlo", "thi", "padded_scan", "scan_pad",
+           "init_states_wake"]
+
+
+def init_states_wake(scenario):
+    """The scenario's stacked initial ``(states, wake)`` — ONE
+    implementation shared by every engine's ``init_state`` and the
+    fault subsystem's restart-reset template (a divergence here would
+    silently split "fresh boot" from "reboot" semantics)."""
+    n = scenario.n_nodes
+    if scenario.init_batched is not None:
+        states, wake = scenario.init_batched(n)
+        wake = jnp.asarray(wake, jnp.int64)
+    else:
+        per = [scenario.init(i) for i in range(n)]
+        states = jax.tree.map(
+            lambda *xs: jnp.stack([jnp.asarray(x) for x in xs]),
+            *[p[0] for p in per])
+        wake = jnp.asarray([p[1] for p in per], jnp.int64)
+    return states, wake
 
 
 def scan_pad(max_steps: int) -> int:

@@ -182,6 +182,11 @@ class EdgeState(NamedTuple):
     delivered: jax.Array   # int64[]
     steps: jax.Array       # int64[]
     time: jax.Array        # int64[] — current virtual time == queue epoch
+    #: int32[] — messages the fault schedule killed (cuts, down-window
+    #: deliveries, reset purges) — mirrors EngineState.fault_dropped
+    fault_dropped: jax.Array
+    #: bool[C] — consumed restart injections (engine.py EngineState)
+    restart_done: jax.Array
 
 
 class EdgeEngine:
@@ -192,7 +197,7 @@ class EdgeEngine:
 
     def __init__(self, scenario: Scenario, link: LinkModel, *,
                  seed: int = 0, cap: int = 2,
-                 lint: str = "warn") -> None:
+                 lint: str = "warn", faults=None) -> None:
         # static scenario sanitizer — same knob contract as JaxEngine
         from ...analysis import check_scenario
         self.lint = lint
@@ -209,22 +214,46 @@ class EdgeEngine:
         self.topo = EdgeTopology.build(scenario.static_dst,
                                        scenario.n_nodes)
         self.comm = LocalComm(scenario.n_nodes)
+        self._setup_faults(faults, scenario, lint)
+
+    # -- faults (same semantics/masks as JaxEngine, classic W=1) ---------
+
+    def _setup_faults(self, faults, scenario, lint) -> None:
+        self.faults = faults
+        self._faulted = faults is not None
+        self._ft = None
+        self.fault_lint_report = None
+        self._has_skew = self._has_reset = False
+        self._n_restarts = 0
+        if faults is None:
+            return
+        from ...faults.schedule import FaultSchedule
+        if not isinstance(faults, FaultSchedule):
+            raise ValueError(
+                f"the edge engine runs one world; faults must be a "
+                f"FaultSchedule, got {faults!r}")
+        from ...analysis import check_faults
+        self.fault_lint_report = check_faults(
+            faults, scenario, lint, who=type(self).__name__)
+        self._has_skew = faults.has_skew
+        self._has_reset = faults.has_reset
+        self._n_restarts = faults.n_restarts
+        tables = faults.tables(scenario.n_nodes)
+        self._ft = type(tables)(*(jnp.asarray(x) for x in tables))
+        if self._has_reset:
+            self._reset_states, _ = self._init_states_wake()
 
     # -- initial state ---------------------------------------------------
+
+    def _init_states_wake(self):
+        from .common import init_states_wake
+        return init_states_wake(self.scenario)
 
     def init_state(self) -> EdgeState:
         sc = self.scenario
         n, E, C, P = sc.n_nodes, self.topo.n_edges, self.cap, \
             sc.payload_width
-        if sc.init_batched is not None:
-            states, wake = sc.init_batched(n)
-            wake = jnp.asarray(wake, jnp.int64)
-        else:
-            per = [sc.init(i) for i in range(n)]
-            states = jax.tree.map(
-                lambda *xs: jnp.stack([jnp.asarray(x) for x in xs]),
-                *[p[0] for p in per])
-            wake = jnp.asarray([p[1] for p in per], jnp.int64)
+        states, wake = self._init_states_wake()
         # q_step orders same-deliver-time messages for the contract-#2
         # sort; a commutative inbox never sorts, so carrying the table
         # through the loop would be pure dead HBM traffic (~2 reads +
@@ -243,6 +272,8 @@ class EdgeEngine:
             delivered=jnp.int64(0),
             steps=jnp.int64(0),
             time=jnp.int64(0),
+            fault_dropped=jnp.int32(0),
+            restart_done=jnp.zeros((self._n_restarts,), bool),
         )
 
     # -- one superstep ---------------------------------------------------
@@ -266,14 +297,47 @@ class EdgeEngine:
             st.wake,
             jnp.where(nnr == _I32MAX, jnp.int64(NEVER),
                       base + nnr.astype(jnp.int64)))
+        if self._faulted:
+            # crash suppression + injected restarts (faults/apply.py;
+            # same masks as JaxEngine)
+            from ...faults.apply import defer_next
+            node_next = defer_next(self._ft, node_ids, node_next,
+                                   st.restart_done)
         t = comm.all_min(node_next.min())
         live = t < NEVER
         fire = (node_next == t) & live
+
+        # 1.5. restart bookkeeping (engine.py twin): consume restart
+        # rows firing now; reset their nodes' state; purge pre-crash
+        # queue entries (counted — memory the reboot lost)
+        restart_done = st.restart_done
+        fault_step = jnp.int32(0)
+        purge = None
+        states_in = st.states
+        if self._faulted and self._has_reset:
+            from ...faults.apply import consume_restarts, restart_fire
+            now_vec = jnp.broadcast_to(t, (n,))  # classic W=1: now == t
+            reset_now, purge_before = restart_fire(
+                self._ft, fire, now_vec, node_ids, st.restart_done)
+            restart_done = consume_restarts(
+                self._ft, fire, now_vec, node_ids, st.restart_done)
+            purge = q_live & (
+                (base + st.q_rel.astype(jnp.int64))
+                < purge_before[None, None, :])
+            fault_step = fault_step + comm.all_sum(
+                jnp.sum(purge, dtype=jnp.int32))
+            states_in = jax.tree.map(
+                lambda cur, init: jnp.where(
+                    reset_now.reshape((n,) + (1,) * (cur.ndim - 1)),
+                    init, cur),
+                st.states, self._reset_states)
 
         # 2. deliverable messages (all per-edge slots due at fired nodes)
         shift32 = jnp.minimum(t - base,
                               jnp.int64(_I32MAX - 1)).astype(jnp.int32)
         deliver = q_live & (st.q_rel <= shift32) & fire[None, None, :]
+        if purge is not None:
+            deliver = deliver & ~purge
 
         # 3. inbox [W, N] — slot-axis views of the queues (leading-axis
         #    reshape: no relayout)
@@ -325,12 +389,16 @@ class EdgeEngine:
         #    outbox leaves (no [N, small] padding anywhere)
         bits = fire_bits(self.s0, self.s1, node_ids, t) \
             if sc.needs_key else None
+        stepf = sc.step
+        if self._faulted and self._has_skew:
+            from ...faults.apply import skewed_step
+            stepf = skewed_step(sc.step, self._ft.skew)
         new_states, out, new_wake = jax.vmap(
-            sc.step,
+            stepf,
             in_axes=(0, Inbox(valid=-1, src=-1, time=-1, payload=-1),
                      None, 0, None if bits is None else 0),
             out_axes=(0, Outbox(valid=-1, dst=-1, payload=-1), 0))(
-                st.states, inbox, t, node_ids, bits)
+                states_in, inbox, t, node_ids, bits)
         states = jax.tree.map(
             lambda a, b: jnp.where(
                 fire.reshape((n,) + (1,) * (b.ndim - 1)), b, a),
@@ -354,6 +422,8 @@ class EdgeEngine:
 
         # 5. rebase surviving queue entries to the new epoch t
         keep = q_live & ~deliver
+        if purge is not None:
+            keep = keep & ~purge
         q_rel = jnp.where(keep, st.q_rel - shift32, _I32MAX)
         q_step = st.q_step
         q_pay = st.q_pay
@@ -384,6 +454,20 @@ class EdgeEngine:
                 if self.link.needs_key else None
             delay, drop = self.link.sample(src_e, node_ids, t, mb)
             ok = arr_v & ~drop
+            if self._faulted:
+                # same drop order as JaxEngine/oracle: partition cut
+                # at the send instant, degradation on the sampled
+                # delay, down-window check on the deliver time
+                from ...faults.apply import (cut_mask, degrade,
+                                             down_mask)
+                cutm = ok & cut_mask(self._ft, src_e, node_ids, t)
+                delay = degrade(self._ft, delay, src_e, node_ids, t)
+                downm = (ok & ~cutm) & down_mask(
+                    self._ft, node_ids,
+                    t + jnp.maximum(delay, jnp.int64(1)))
+                fault_step = fault_step + comm.all_sum(
+                    jnp.sum(cutm | downm, dtype=jnp.int32))
+                ok = ok & ~cutm & ~downm
             drel64 = jnp.maximum(delay, jnp.int64(1))       # contract #4
             # queue times are int32-relative; a >= 2^31 µs delay cannot
             # be represented — clamp and count, never wrap silently
@@ -425,6 +509,8 @@ class EdgeEngine:
             delivered=st.delivered + recv_count.astype(jnp.int64),
             steps=st.steps + 1,
             time=t,
+            fault_dropped=st.fault_dropped + fault_step,
+            restart_done=restart_done,
         )
         final = jax.tree.map(lambda a, b: jnp.where(live, b, a), st, new_st)
         if not with_trace:

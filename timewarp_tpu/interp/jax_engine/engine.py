@@ -99,6 +99,17 @@ class EngineState(NamedTuple):
     ev_time: jax.Array     # int64[E]
     ev_meta: jax.Array     # int32[4, E]
     ev_count: jax.Array    # int64[]
+    #: int32[] — messages killed by the fault schedule (partition-cut
+    #: sends, deliveries into a down node's window, mailbox entries a
+    #: reset restart purged) — counted, never silent, mirroring the
+    #: oracle's ``fault_dropped_total`` (faults/, round 9). Always
+    #: present (0 and shape-[0] restart ledger when no faults) so the
+    #: state pytree is engine-interchange stable.
+    fault_dropped: jax.Array
+    #: bool[C] — which crash rows' injected restart firings have been
+    #: consumed (faults/apply.py module docstring: the one piece of
+    #: state fault masks need)
+    restart_done: jax.Array
 
 
 class JaxEngine:
@@ -183,6 +194,20 @@ class JaxEngine:
     (PERF_r05.md). ``record_events`` is solo-only (the ring decoder is
     a single-run debug artifact — record world b's events by running
     it solo, which is bit-identical by the law above).
+
+    Scheduled fault injection (``faults=FaultSchedule``, faults/):
+    deterministic time-varying chaos applied as pure masks inside the
+    superstep — crash windows suppress firing and drop deliveries
+    (``reset_state`` reboots the node at ``t_up`` with state loss),
+    partitions drop cross-cut sends, degradation windows transform
+    sampled delays, clock skews shift a node's view of time. All
+    fault losses are counted in ``EngineState.fault_dropped`` (never
+    silent) and the oracle applies the identical semantics, so chaos
+    runs stay inside the trace-parity law. Batched: pass a
+    ``FaultFleet`` (or one schedule, replicated to every world) —
+    world b runs its own schedule, and the batch exactness law
+    extends: world-b slice of a chaos fleet ≡ the solo run with
+    ``fleet.world_schedule(b)`` (docs/faults.md).
     """
 
     def __init__(self, scenario: Scenario, link: LinkModel, *,
@@ -190,7 +215,8 @@ class JaxEngine:
                  route_cap: Optional[int] = None,
                  record_events: int = 0,
                  lint: str = "warn",
-                 batch: Optional[BatchSpec] = None) -> None:
+                 batch: Optional[BatchSpec] = None,
+                 faults=None) -> None:
         # static scenario sanitizer (analysis/): "warn" logs findings,
         # "error" refuses to construct on contract violations, "off"
         # skips entirely (bit-for-bit the pre-lint behavior — the
@@ -223,6 +249,20 @@ class JaxEngine:
         else:
             self._world_links = None
             link_floor = link.min_delay_us
+        self.scenario = scenario  # before faults: the restart-reset
+        self.link = link          # template stacks Scenario.init
+        self._setup_faults(faults, scenario, lint)
+        if self._faulted:
+            if route_cap is not None:
+                raise ValueError(
+                    "faults and route_cap cannot combine: the capped "
+                    "lazy-sampling path slices before delays (and so "
+                    "before down-window drops) exist — run the fault "
+                    "study uncapped (adaptive routing never drops)")
+            # a shrink-degradation window can undercut the link's
+            # declared floor: windowed validation (and "auto") must
+            # use the degraded worst case, never silently reorder
+            link_floor = self.faults.min_delay_floor(link_floor)
         if isinstance(window, str) and window != "auto":
             # a typo'd "Auto"/"8ms" from a library caller would
             # otherwise fall through to `window < 1` and raise an
@@ -251,8 +291,7 @@ class JaxEngine:
             raise ValueError("window must fit int32")
         if route_cap is not None and route_cap < 1:
             raise ValueError(f"route_cap must be >= 1, got {route_cap}")
-        self.scenario = scenario
-        self.link = link
+        # (self.scenario / self.link were assigned before _setup_faults)
         self.window = int(window)
         self.route_cap = None if route_cap is None else int(route_cap)
         #: event-ring capacity (0 = recording off): with it on, every
@@ -280,20 +319,69 @@ class JaxEngine:
         #: skip the [K, N] free-rows sort entirely
         self._fused_holes = False
 
+    # -- faults (faults/: scheduled chaos inside the superstep) ----------
+
+    def _setup_faults(self, faults, scenario, lint) -> None:
+        """Normalize/validate the ``faults`` argument and lower it to
+        the :class:`~timewarp_tpu.faults.schedule.FaultTables` the
+        superstep masks close over (solo: ``self._ft``) or ``vmap``
+        (batched: ``self._ftv``, leading world axis). Runs the TW5xx
+        fault lints under the same ``lint`` knob as the scenario
+        sanitizer."""
+        self.faults = faults
+        self._faulted = faults is not None
+        self._ft = None
+        self._ftv = None
+        self.fault_lint_report = None
+        self._has_skew = self._has_reset = False
+        self._n_restarts = 0
+        if faults is None:
+            return
+        from ...faults.schedule import FaultFleet, FaultSchedule, as_fleet
+        if self.batch is not None:
+            faults = as_fleet(faults, self.batch.B)
+        elif isinstance(faults, FaultFleet):
+            raise ValueError(
+                "a FaultFleet carries per-world schedules; it needs "
+                "batch=BatchSpec (a solo run takes one FaultSchedule)")
+        elif not isinstance(faults, FaultSchedule):
+            raise ValueError(
+                f"faults must be a FaultSchedule (or a FaultFleet "
+                f"with batch=), got {faults!r}; build one with "
+                "FaultSchedule((NodeCrash(...), ...)) or "
+                "faults.parse_faults()")
+        self.faults = faults
+        from ...analysis import check_faults
+        self.fault_lint_report = check_faults(
+            faults, scenario, lint, who=type(self).__name__)
+        self._has_skew = faults.has_skew
+        self._has_reset = faults.has_reset
+        self._n_restarts = faults.n_restarts
+        tables = faults.tables(scenario.n_nodes)
+        ftj = type(tables)(*(jnp.asarray(x) for x in tables))
+        if self.batch is not None:
+            self._ftv = ftj
+        else:
+            self._ft = ftj
+        if self._has_reset:
+            # the reboot template: Scenario.init's states, the same
+            # arrays init_state stacks (seed-independent, so one
+            # template serves every world of a fleet)
+            self._reset_states, _ = self._init_states_wake()
+
     # -- initial state ---------------------------------------------------
+
+    def _init_states_wake(self):
+        """The scenario's stacked initial ``(states, wake)`` — shared
+        by :meth:`init_state` and the fault subsystem's restart-reset
+        template (one implementation, common.py)."""
+        from .common import init_states_wake
+        return init_states_wake(self.scenario)
 
     def init_state(self) -> EngineState:
         sc = self.scenario
         n, K, P = sc.n_nodes, sc.mailbox_cap, sc.payload_width
-        if sc.init_batched is not None:
-            states, wake = sc.init_batched(n)
-            wake = jnp.asarray(wake, jnp.int64)
-        else:
-            per = [sc.init(i) for i in range(n)]
-            states = jax.tree.map(
-                lambda *xs: jnp.stack([jnp.asarray(x) for x in xs]),
-                *[p[0] for p in per])
-            wake = jnp.asarray([p[1] for p in per], jnp.int64)
+        states, wake = self._init_states_wake()
         st = EngineState(
             states=states,
             wake=wake,
@@ -311,6 +399,8 @@ class JaxEngine:
             ev_time=jnp.zeros((self.record_events,), jnp.int64),
             ev_meta=jnp.zeros((4, self.record_events), jnp.int32),
             ev_count=jnp.int64(0),
+            fault_dropped=jnp.int32(0),
+            restart_done=jnp.zeros((self._n_restarts,), bool),
         )
         if self.batch is not None:
             # the world axis: every leaf gains a leading B dim. Worlds
@@ -363,6 +453,11 @@ class JaxEngine:
         mbits = msg_bits(self.s0, self.s1, src, dst, tmsg, slot) \
             if self.link.needs_key else None
         delay, _ = self.link.sample(src, dst, tmsg, mbits)
+        if self._faulted:
+            # degradation windows transform the sampled delay BEFORE
+            # the flight clamp (faults/apply.py; oracle order matches)
+            from ...faults.apply import degrade
+            delay = degrade(self._ft, delay, src, dst, tmsg)
         flight = jnp.maximum(delay, jnp.int64(1))       # contract #4
         drel64 = woff.astype(jnp.int64) + flight
         bad = jnp.sum(ok & (drel64 > jnp.int64(_I32MAX - 1)),
@@ -446,6 +541,15 @@ class JaxEngine:
         dst_okf = (dst32 >= 0) & (dst32 < n_glob)
         bad_dst_step = jnp.sum(out_valid & ~dst_okf, dtype=jnp.int32)
         pdst = jnp.where(out_valid & dst_okf, dst32, -1)        # [M, N]
+        fault_cut = jnp.int32(0)
+        if self._faulted and self._ft.part_group.shape[0]:
+            # partition cuts are sample-independent: kill them before
+            # compaction (counted; the oracle drops the same set)
+            from ...faults.apply import cut_mask
+            cutm = (pdst >= 0) & cut_mask(
+                self._ft, node_ids[None, :], pdst, now_vec[None, :])
+            fault_cut = jnp.sum(cutm, dtype=jnp.int32)
+            pdst = jnp.where(cutm, jnp.int32(-1), pdst)
         sender_live = jnp.any(pdst >= 0, axis=0)                # [N]
         n_active = jnp.sum(sender_live, dtype=jnp.int32)
         sid_sorted = jax.lax.sort(
@@ -455,7 +559,7 @@ class JaxEngine:
         woff_n = (now_vec - t).astype(jnp.int32)                # [N]
 
         def tail(A):
-            def branch():
+            def gather(A):
                 sids = jax.lax.slice_in_dim(sid_sorted, 0, A)
                 real = sids < n
                 sidc = jnp.where(real, sids, 0)  # safe gather index
@@ -471,8 +575,67 @@ class JaxEngine:
                                            (M, A))
                           + jnp.arange(M, dtype=jnp.int32)[:, None]
                           ).reshape(SA)
-                sort_dst = jnp.where(ok, dst_f, n)
                 pay_f = tuple(p.reshape(SA) for p in pay_a)
+                return SA, woff_a, dst_f, ok, smrank, pay_f
+
+            def branch_faulted():
+                # sample BEFORE the routing sort: the down-window drop
+                # needs each message's deliver time, and insertion
+                # ranks must count only genuinely inserted messages
+                # (a post-sort mask would corrupt per-dst slot ranks).
+                # Value-identical to the lazy ordering — link entropy
+                # is keyed per message, not per lane position.
+                from ...faults.apply import down_mask
+                SA, woff_a, dst_f, ok, smrank, pay_f = gather(A)
+                woff_f = jnp.broadcast_to(
+                    woff_a[None, :], (M, A)).reshape(SA) \
+                    if W > 1 else jnp.zeros((SA,), jnp.int32)
+                src_l = smrank // jnp.int32(M)
+                tmsg_l = t + woff_f.astype(jnp.int64)
+                flight, drel, bad_delay_step, short_step = \
+                    self._sample_nodrop(src_l, dst_f, tmsg_l,
+                                        smrank % jnp.int32(M),
+                                        woff_f, ok)
+                downm = ok & down_mask(self._ft, dst_f,
+                                       tmsg_l + flight)
+                fault_down = jnp.sum(downm, dtype=jnp.int32)
+                ok2 = ok & ~downm
+                sent_count = jnp.sum(ok2, dtype=jnp.int32)
+                if with_trace:
+                    dt_abs = tmsg_l + flight
+                    sent_mix = mix32_jnp(SENT, src_l, dst_f,
+                                         _tlo(dt_abs), _thi(dt_abs),
+                                         pay_f[0])
+                    sent_hash = _u32sum(jnp.where(ok2, sent_mix, 0))
+                else:
+                    sent_hash = jnp.uint32(0)
+                sort_dst = jnp.where(ok2, dst_f, n)
+                if W > 1:
+                    ops = jax.lax.sort(
+                        (sort_dst, woff_f, smrank, drel) + pay_f,
+                        dimension=0, num_keys=3)
+                    sd, smrank_s, drel_s = ops[0], ops[2], ops[3]
+                    pay_s = ops[4:]
+                else:
+                    ops = jax.lax.sort(
+                        (sort_dst, smrank, drel) + pay_f,
+                        dimension=0, num_keys=2)
+                    sd, smrank_s, drel_s = ops[0], ops[1], ops[2]
+                    pay_s = ops[3:]
+                ok_s = sd < n
+                src_s = smrank_s // jnp.int32(M)
+                mrel, msrc, mpay, overflow_step = self._insert_sorted(
+                    mb_rel, mb_src, mb_payload, sd, ok_s, drel_s,
+                    src_s, pay_s, free_rows, counts)
+                return (mrel, msrc, mpay, overflow_step, bad_dst_step,
+                        bad_delay_step, short_step, jnp.int32(0),
+                        sent_count, sent_hash, fault_cut + fault_down)
+            if self._faulted:
+                return branch_faulted
+
+            def branch():
+                SA, woff_a, dst_f, ok, smrank, pay_f = gather(A)
+                sort_dst = jnp.where(ok, dst_f, n)
                 if W > 1:
                     woff_f = jnp.broadcast_to(
                         woff_a[None, :], (M, A)).reshape(SA)
@@ -547,6 +710,13 @@ class JaxEngine:
             st.wake,
             jnp.where(nnr == _I32MAX, jnp.int64(NEVER),
                       base + nnr.astype(jnp.int64)))
+        if self._faulted:
+            # crash suppression: events inside a down window slide to
+            # its t_up, and unconsumed reset rows inject the restart
+            # firing (faults/apply.py)
+            from ...faults.apply import defer_next
+            node_next = defer_next(self._ft, node_ids, node_next,
+                                   st.restart_done)
         t = comm.all_min(node_next.min())
         live = t < NEVER
         # windowed firing: every node with an event in [t, t+W) fires,
@@ -564,9 +734,35 @@ class JaxEngine:
         nrel = jnp.minimum(now_vec - base,
                            jnp.int64(_I32MAX - 1)).astype(jnp.int32)
 
+        # 1.5. restart bookkeeping: consume reset rows whose node
+        # fires at its t_up this superstep; their state resets to the
+        # init template below, and mailbox entries older than the
+        # crash are purged (memory loss — counted, never delivered)
+        restart_done = st.restart_done
+        fault_purged = jnp.int32(0)
+        purge = None
+        states_in = st.states
+        if self._faulted and self._has_reset:
+            from ...faults.apply import consume_restarts, restart_fire
+            reset_now, purge_before = restart_fire(
+                self._ft, fire, now_vec, node_ids, st.restart_done)
+            restart_done = consume_restarts(
+                self._ft, fire, now_vec, node_ids, st.restart_done)
+            purge = mb_live & (
+                (base + st.mb_rel.astype(jnp.int64))
+                < purge_before[None, :])
+            fault_purged = comm.all_sum(jnp.sum(purge, dtype=jnp.int32))
+            states_in = jax.tree.map(
+                lambda cur, init: jnp.where(
+                    reset_now.reshape((n,) + (1,) * (cur.ndim - 1)),
+                    init, cur),
+                st.states, self._reset_states)
+
         # 2. deliverable messages: due at or before the node's own
         #    firing instant (== `<= shift32` when W == 1)
         deliver = mb_live & (st.mb_rel <= nrel[None, :]) & fire[None, :]
+        if purge is not None:
+            deliver = deliver & ~purge
 
         # 3. inbox: delivered slots first, ordered by (time, arrival slot)
         #    (determinism contract #2) — one variadic sort along K.
@@ -614,12 +810,18 @@ class JaxEngine:
         # Batch axis is the *minor* dim for inbox and outbox leaves.
         bits = fire_bits(self.s0, self.s1, node_ids, now_vec) \
             if sc.needs_key else None
+        stepf = sc.step
+        if self._faulted and self._has_skew:
+            # the node's VIEW of time shifts; entropy keys, digests
+            # and fault windows stay on true time (faults/apply.py)
+            from ...faults.apply import skewed_step
+            stepf = skewed_step(sc.step, self._ft.skew)
         new_states, out, new_wake = jax.vmap(
-            sc.step,
+            stepf,
             in_axes=(0, Inbox(valid=-1, src=-1, time=-1, payload=-1),
                      0, 0, None if bits is None else 0),
             out_axes=(0, Outbox(valid=-1, dst=-1, payload=-1), 0))(
-                st.states, inbox, now_vec, node_ids, bits)
+                states_in, inbox, now_vec, node_ids, bits)
         states = jax.tree.map(
             lambda a, b: jnp.where(
                 fire.reshape((n,) + (1,) * (b.ndim - 1)), b, a),
@@ -639,6 +841,8 @@ class JaxEngine:
         #    - ordered inbox: the variadic compaction sort keeps arrival
         #      order materialized in slot order (contract #2's tiebreak).
         keep = mb_live & ~deliver
+        if purge is not None:
+            keep = keep & ~purge
         if sc.commutative_inbox:
             mb_rel = jnp.where(keep, st.mb_rel - shift32, _I32MAX)
             mb_src = st.mb_src          # stale in holes; validity is the
@@ -682,17 +886,23 @@ class JaxEngine:
                     and type(comm) is LocalComm
                     and (W > 1 or M > 1))
         if adaptive:
+            res = self._route_adaptive(
+                out, out_valid, now_vec, t, mb_rel, mb_src,
+                mb_payload, free_rows, counts, node_ids, with_trace)
             (mb_rel, mb_src, mb_payload, overflow_step, bad_dst_step,
              bad_delay_step, short_step, route_drop_step, sent_count,
-             sent_hash) = \
-                self._route_adaptive(
-                    out, out_valid, now_vec, t, mb_rel, mb_src,
-                    mb_payload, free_rows, counts, node_ids, with_trace)
+             sent_hash) = res[:10]
+            # the faulted routing variant appends its fault-drop count
+            # (partition cuts + down-window deliveries); the fused
+            # override and the unfaulted tail return the bare 10-tuple
+            fault_route = res[10] if len(res) > 10 else jnp.int32(0)
             return self._finish_superstep(
                 st, live, states, wake, mb_rel, mb_src, mb_payload,
                 deliver, fire, node_ids, t, base, now_vec,
                 overflow_step, bad_dst_step, bad_delay_step, short_step,
-                route_drop_step, sent_count, sent_hash, with_trace)
+                route_drop_step, sent_count, sent_hash, with_trace,
+                fault_dropped_step=fault_purged + fault_route,
+                restart_done=restart_done)
         S = n * M
         src_f = jnp.tile(node_ids, M)
         slot_f = jnp.repeat(jnp.arange(M, dtype=jnp.int32), n)
@@ -725,6 +935,9 @@ class JaxEngine:
         lazy = (self.route_cap is not None
                 and not self.link.can_drop
                 and type(comm) is LocalComm)
+        #: routed messages the fault schedule killed this superstep
+        #: (the lazy path never runs faulted: faults reject route_cap)
+        fault_eager = jnp.int32(0)
 
         def slice_cap(ops, ok_mask):
             """route_cap: valid messages sort to the front (sentinel
@@ -776,6 +989,15 @@ class JaxEngine:
                              slot_f) if self.link.needs_key else None
             delay, drop = self.link.sample(src_f, dst_f, tmsg, mbits)
             ok = v_f & ~drop & dst_ok
+            if self._faulted:
+                # partition cuts (send-time) before the flight clamp;
+                # down-window drops (deliver-time) after — the same
+                # check order as the oracle's routing loop
+                from ...faults.apply import cut_mask, degrade
+                cutm = ok & cut_mask(self._ft, src_f, dst_f, tmsg)
+                fault_eager = jnp.sum(cutm, dtype=jnp.int32)
+                ok = ok & ~cutm
+                delay = degrade(self._ft, delay, src_f, dst_f, tmsg)
             flight = jnp.maximum(delay, jnp.int64(1))  # contract #4
             drel64 = woff.astype(jnp.int64) + flight
             bad_delay_step = comm.all_sum(jnp.sum(
@@ -789,6 +1011,17 @@ class JaxEngine:
                 if W > 1 else jnp.int32(0)
             drel = jnp.minimum(drel64,
                                jnp.int64(_I32MAX - 1)).astype(jnp.int32)
+            if self._faulted:
+                # deliver-time drop: the destination's NIC is off for
+                # the whole down window, so a message landing inside
+                # it is lost — before the exchange (it never ships)
+                # and before the SENT digest (the oracle never hashes
+                # it either)
+                from ...faults.apply import down_mask
+                downm = ok & down_mask(self._ft, dst_f, t + drel64)
+                fault_eager = comm.all_sum(
+                    fault_eager + jnp.sum(downm, dtype=jnp.int32))
+                ok = ok & ~downm
 
             # 6.5. hand each message to the device that owns its
             # destination (identity single-chip; bucket + all_to_all
@@ -850,13 +1083,16 @@ class JaxEngine:
             st, live, states, wake, mb_rel, mb_src, mb_payload,
             deliver, fire, node_ids, t, base, now_vec,
             overflow_step, bad_dst_step, bad_delay_step, short_step,
-            route_drop_step, sent_count, sent_hash, with_trace)
+            route_drop_step, sent_count, sent_hash, with_trace,
+            fault_dropped_step=fault_purged + fault_eager,
+            restart_done=restart_done)
 
     def _finish_superstep(self, st, live, states, wake, mb_rel, mb_src,
                           mb_payload, deliver, fire, node_ids, t, base,
                           now_vec, overflow_step, bad_dst_step,
                           bad_delay_step, short_step, route_drop_step,
-                          sent_count, sent_hash, with_trace):
+                          sent_count, sent_hash, with_trace,
+                          fault_dropped_step=None, restart_done=None):
         """Assemble the post-superstep state and (optionally) the trace
         row — shared by all routing regimes. ``sent_count`` /
         ``sent_hash`` are computed by the caller (their inputs live at
@@ -916,6 +1152,11 @@ class JaxEngine:
             steps=st.steps + 1,
             time=t,
             ev_time=ev_time, ev_meta=ev_meta, ev_count=ev_count,
+            fault_dropped=st.fault_dropped + (
+                jnp.int32(0) if fault_dropped_step is None
+                else fault_dropped_step),
+            restart_done=st.restart_done if restart_done is None
+            else restart_done,
         )
         # freeze everything once quiesced
         final = jax.tree.map(lambda a, b: jnp.where(live, b, a), st, new_st)
@@ -951,32 +1192,36 @@ class JaxEngine:
 
     # -- the world axis (batch=BatchSpec) --------------------------------
 
-    def _vstep(self, st, s0v, s1v, lpv, with_trace: bool):
+    def _vstep(self, st, s0v, s1v, lpv, ftv, with_trace: bool):
         """One superstep of every world: ``vmap`` of ``_superstep``
         over the leading world axis of ``st`` and the world context
-        (per-world seed words + link parameters). The per-world seed
-        and link are bound onto ``self`` for the single trace vmap
-        performs — the traced values ARE the per-world tracers, so the
-        compiled program maps them; ``_superstep`` itself is
-        unchanged (the whole point: one superstep implementation,
-        solo or fleet)."""
-        def world(st_w, s0, s1, lp):
-            prev = (self.s0, self.s1, self.link)
+        (per-world seed words + link parameters + fault tables). The
+        per-world seed, link, and fault schedule are bound onto
+        ``self`` for the single trace vmap performs — the traced
+        values ARE the per-world tracers, so the compiled program maps
+        them; ``_superstep`` itself is unchanged (the whole point: one
+        superstep implementation, solo or fleet)."""
+        def world(st_w, s0, s1, lp, ft):
+            prev = (self.s0, self.s1, self.link, self._ft)
             self.s0, self.s1 = s0, s1
             if lp:
                 self.link = rebind_link(self.link, lp)
+            if ft is not None:
+                self._ft = ft
             try:
                 return self._superstep(st_w, with_trace)
             finally:
-                self.s0, self.s1, self.link = prev
-        return jax.vmap(world, in_axes=(0, 0, 0, 0))(st, s0v, s1v, lpv)
+                self.s0, self.s1, self.link, self._ft = prev
+        return jax.vmap(world, in_axes=(0, 0, 0, 0,
+                                        None if ftv is None else 0))(
+            st, s0v, s1v, lpv, ftv)
 
     def _step_all(self, st, with_trace: bool):
         """One driver step: the solo superstep, or the vmapped fleet."""
         if self.batch is None:
             return self._superstep(st, with_trace)
         return self._vstep(st, self._s0v, self._s1v, self._lpv,
-                           with_trace)
+                           self._ftv, with_trace)
 
     def _any_world(self, x):
         """Whether any world (on any device) is still active — the

@@ -399,6 +399,8 @@ class FusedRingEngine:
             unrouted=jnp.int32(0), misrouted=jnp.int32(0),
             bad_delay=jnp.int32(0),
             delivered=fs.delivered, steps=fs.steps, time=fs.base,
+            fault_dropped=jnp.int32(0),
+            restart_done=jnp.zeros((0,), bool),
         )
 
     # -- one superstep ---------------------------------------------------

@@ -72,6 +72,7 @@ class ShardedEdgeEngine(ShardedDriver, EdgeEngine):
             q_pay=leaf(st.q_pay, True),
             overflow=P(), unrouted=P(), misrouted=P(), bad_delay=P(),
             delivered=P(), steps=P(), time=P(),
+            fault_dropped=P(), restart_done=P(),
         )
 
 
@@ -172,6 +173,9 @@ class ShardedEngine(ShardedDriver, JaxEngine):
             # the event ring is a single-chip debug artifact
             # (record_events=0 sharded: zero-size, replicated)
             ev_time=P(), ev_meta=P(), ev_count=P(),
+            # faults are the local/world-sharded engines' lever; the
+            # node-sharded engine carries the (empty) leaves replicated
+            fault_dropped=P(), restart_done=P(),
         )
 
 
@@ -196,9 +200,10 @@ class ShardedBatchedEngine(ShardedDriver, JaxEngine):
                  mesh: Mesh, *, batch: BatchSpec,
                  axis: AxisName = "worlds", seed: int = 0,
                  window=1, route_cap: Optional[int] = None,
-                 lint: str = "warn") -> None:
+                 lint: str = "warn", faults=None) -> None:
         super().__init__(scenario, link, seed=seed, window=window,
-                         route_cap=route_cap, lint=lint, batch=batch)
+                         route_cap=route_cap, lint=lint, batch=batch,
+                         faults=faults)
         if batch is None:
             raise ValueError(
                 "ShardedBatchedEngine shards the world axis; it needs "
@@ -239,9 +244,11 @@ class ShardedBatchedEngine(ShardedDriver, JaxEngine):
             * jnp.int32(Bl)
         def sl(v):
             return jax.lax.dynamic_slice_in_dim(v, off, Bl, axis=0)
+        ftv = None if self._ftv is None else \
+            jax.tree.map(sl, self._ftv)
         return self._vstep(st, sl(self._s0v), sl(self._s1v),
                            {k: sl(v) for k, v in self._lpv.items()},
-                           with_trace)
+                           ftv, with_trace)
 
     def _any_world(self, x):
         # liveness must be mesh-wide: one device's worlds finishing

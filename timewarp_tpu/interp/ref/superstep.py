@@ -56,7 +56,7 @@ class SuperstepOracle:
 
     def __init__(self, scenario: Scenario, link: LinkModel, *,
                  seed: int = 0, record_events: bool = False,
-                 window=1, lint: str = "warn") -> None:
+                 window=1, lint: str = "warn", faults=None) -> None:
         # static scenario sanitizer — same knob contract as the
         # engines (analysis/check_scenario); the oracle is the
         # referee, so catching a contract violation here names it
@@ -65,6 +65,12 @@ class SuperstepOracle:
         self.lint = lint
         self.lint_report = check_scenario(scenario, lint,
                                           who=type(self).__name__)
+        link_floor = link.min_delay_us
+        self._setup_faults(faults, scenario, lint)
+        if self._faulted:
+            # shrink-degradation windows lower the exact-window floor
+            # (mirrors JaxEngine)
+            link_floor = self.faults.min_delay_floor(link_floor)
         if isinstance(window, str) and window != "auto":
             # mirror JaxEngine: a typo'd "Auto"/"8ms" from a library
             # caller must fail clearly, not as `window < 1`'s opaque
@@ -73,13 +79,14 @@ class SuperstepOracle:
                 f"window must be an int µs count or the string "
                 f"'auto', got {window!r}")
         if window == "auto":    # mirror JaxEngine: link floor = widest
-            window = max(1, int(link.min_delay_us))  # exact window
+            window = max(1, int(link_floor))  # exact window
         if window < 1:
             raise ValueError(f"window must be >= 1 µs, got {window}")
-        if window > 1 and window > link.min_delay_us:
+        if window > 1 and window > link_floor:
             raise ValueError(
                 f"window={window} µs exceeds the link model's declared "
-                f"min_delay_us={link.min_delay_us}")
+                f"min_delay_us={link_floor}"
+                f"{' (degradation-adjusted)' if self._faulted else ''}")
         self.scenario = scenario
         self.link = link
         self.window = int(window)
@@ -102,7 +109,15 @@ class SuperstepOracle:
         self.overflow_total = 0
         self.bad_dst_total = 0
         self.short_delay_total = 0
+        #: messages the fault schedule killed (cuts + down-window
+        #: deliveries + reset purges) — mirrors
+        #: ``EngineState.fault_dropped``
+        self.fault_dropped_total = 0
         self.time: Microsecond = 0
+        if self._faulted and self.faults.has_reset:
+            # pristine reboot template (self.states is mutated in
+            # place as the run progresses)
+            self._reset_states = jax.tree.map(np.copy, self.states)
 
         ids = jnp.arange(n, dtype=jnp.int32)
         M = scenario.max_out
@@ -112,35 +127,143 @@ class SuperstepOracle:
         # one vmapped step per superstep — same fn the engine vmaps;
         # entropy derived elementwise (core/rng.py), no key arrays.
         # `now` is per-node (each fires at its own in-window instant;
-        # all equal to t when window == 1).
+        # all equal to t when window == 1). Clock skew wraps the SAME
+        # step function the engine wraps (faults/apply.py), so skewed
+        # behavior cannot diverge between interpreters.
+        stepf = scenario.step
+        if self._faulted and self.faults.has_skew:
+            from ...faults.apply import skewed_step
+            stepf = skewed_step(scenario.step,
+                                jnp.asarray(self._ft.skew))
+
         def _vstep(states, inbox, now):
             if scenario.needs_key:
                 bits = fire_bits(self.s0, self.s1, ids, now)
             else:
                 bits = None
             return jax.vmap(
-                scenario.step,
+                stepf,
                 in_axes=(0, 0, 0, 0, None if bits is None else 0))(
                     states, inbox, now, ids, bits)
 
         self._vstep = jax.jit(_vstep)
 
         # one batched link sample per superstep, keyed per
-        # (src,dst,send-instant,slot); link models broadcast — no vmap
+        # (src,dst,send-instant,slot); link models broadcast — no vmap.
+        # Degradation windows transform the sampled delay here, with
+        # the same integer helper the engines trace — identical bits.
         def _vsample(dst, tmsg):
             if link.needs_key:
                 bits = msg_bits(self.s0, self.s1, src_f, dst, tmsg, slot_f)
             else:
                 bits = None
-            return link.sample(src_f, dst, tmsg, bits)
+            delay, drop = link.sample(src_f, dst, tmsg, bits)
+            if self._faulted:
+                from ...faults.apply import degrade
+                ftj = jax.tree.map(jnp.asarray, self._ft)
+                delay = degrade(ftj, delay, src_f, dst, tmsg)
+            return delay, drop
 
         self._vsample = jax.jit(_vsample)
+
+    # -- faults (host-side mirror of faults/apply.py) -------------------
+
+    def _setup_faults(self, faults, scenario, lint) -> None:
+        """Validate the ``faults`` argument and precompute the plain-
+        Python crash/partition row lists the run loop's *independent*
+        scheduling decisions use (the oracle shares only the jitted
+        value functions — step, sample, degrade — with the engines;
+        every who-fires/what-drops decision is re-made here in host
+        code, which is what makes it an oracle)."""
+        self.faults = faults
+        self._faulted = faults is not None
+        self._ft = None
+        self.fault_lint_report = None
+        if faults is None:
+            return
+        from ...faults.schedule import FaultFleet, FaultSchedule
+        if isinstance(faults, FaultFleet):
+            raise ValueError(
+                "the oracle runs one world; pass one FaultSchedule "
+                "(fleet.world_schedule(b) for a batched world's twin)")
+        if not isinstance(faults, FaultSchedule):
+            raise ValueError(
+                f"faults must be a FaultSchedule, got {faults!r}")
+        from ...analysis import check_faults
+        self.fault_lint_report = check_faults(
+            faults, scenario, lint, who=type(self).__name__)
+        self._ft = faults.tables(scenario.n_nodes)
+        #: (node, down, up, reset) for ACTIVE crash rows, with their
+        #: table row index (the restart ledger key)
+        self._crash_rows = [
+            (int(self._ft.crash_node[c]), int(self._ft.crash_down[c]),
+             int(self._ft.crash_up[c]), bool(self._ft.crash_reset[c]), c)
+            for c in range(self._ft.crash_node.shape[0])
+            if self._ft.crash_up[c] > self._ft.crash_down[c]]
+        self._restart_done = [False] * self._ft.crash_node.shape[0]
+        self._parts = [
+            (self._ft.part_group[p], int(self._ft.part_start[p]),
+             int(self._ft.part_end[p]))
+            for p in range(self._ft.part_group.shape[0])
+            if self._ft.part_end[p] > self._ft.part_start[p]]
+
+    def _fault_next(self, i: int, x: int) -> int:
+        """Crash-adjusted next-event time for node ``i`` (engine twin:
+        ``defer_next``): defer an in-window event to its t_up, then
+        min in any unconsumed restart injection."""
+        ups = [u for (k, d, u, _r, _c) in self._crash_rows
+               if k == i and d <= x < u]
+        if ups:
+            x = max(ups)
+        inj = min((u for (k, _d, u, r, c) in self._crash_rows
+                   if k == i and r and not self._restart_done[c]),
+                  default=NEVER)
+        return min(x, inj)
+
+    def _cut(self, src: int, dst: int, t: int) -> bool:
+        """Does a (src -> dst) message sent at ``t`` cross a live
+        partition cut?"""
+        for group, start, end in self._parts:
+            if start <= t < end:
+                gs, gd = int(group[src]), int(group[dst])
+                if gs >= 0 and gd >= 0 and gs != gd:
+                    return True
+        return False
+
+    def _down(self, node: int, t: int) -> bool:
+        """Is ``node`` inside a crash window at time ``t``?"""
+        return any(k == node and d <= t < u
+                   for (k, d, u, _r, _c) in self._crash_rows)
+
+    def _restart(self, i: int, ti: int) -> None:
+        """Consume restart rows for node ``i`` firing at ``ti``; on a
+        reset restart, reboot the state from the pristine template and
+        purge mailbox entries older than the crash (memory loss,
+        counted in ``fault_dropped_total``)."""
+        purge_before, rebooted = 0, False
+        for (k, d, u, r, c) in self._crash_rows:
+            if r and not self._restart_done[c] and k == i and ti == u:
+                self._restart_done[c] = True
+                rebooted = True
+                purge_before = max(purge_before, d)
+        if rebooted:
+            def _reset(cur, init):
+                cur[i] = init[i]
+                return cur
+            self.states = jax.tree.map(_reset, self.states,
+                                       self._reset_states)
+            kept = [m for m in self.mailbox[i] if m[0] >= purge_before]
+            self.fault_dropped_total += len(self.mailbox[i]) - len(kept)
+            self.mailbox[i] = kept
 
     # ------------------------------------------------------------------
 
     def _node_next(self, i: int) -> int:
         m = min((mm[0] for mm in self.mailbox[i]), default=NEVER)
-        return min(self.wake[i], m)
+        nxt = min(self.wake[i], m)
+        if self._faulted:
+            nxt = self._fault_next(i, nxt)
+        return nxt
 
     # ------------------------------------------------------------------
 
@@ -168,6 +291,13 @@ class SuperstepOracle:
             fired_hash = combine_py(mix32_py(FIRED, i) for i in fired)
             if self.events is not None:
                 self.events.extend(("fire", nexts[i], i) for i in fired)
+            if self._faulted:
+                # restart firings: consume the injected reboot, reset
+                # state from the template, purge pre-crash mailbox
+                # memory — BEFORE inboxes are built (engine: the purge
+                # mask is excluded from `deliver`)
+                for i in fired:
+                    self._restart(i, nexts[i])
 
             # build inboxes (host decision: contract #2 ordering);
             # deliverable = due at the node's own firing instant
@@ -250,12 +380,23 @@ class SuperstepOracle:
                         continue
                     if drop[i, slot]:
                         continue
+                    if self._faulted and self._cut(i, dst, ti):
+                        # sent across a live partition cut: lost in
+                        # transit — counted, never hashed (the engine
+                        # kills the same set pre-insertion)
+                        self.fault_dropped_total += 1
+                        continue
                     flight = max(int(delay[i, slot]), 1)  # contract #4
                     if W > 1 and flight < W:
                         # windowed-causality violation — counted loudly,
                         # mirroring EngineState.short_delay
                         self.short_delay_total += 1
                     dt = ti + flight
+                    if self._faulted and self._down(dst, dt):
+                        # would land inside the destination's down
+                        # window: its NIC is off — counted, dropped
+                        self.fault_dropped_total += 1
+                        continue
                     p0 = int(out_pay[i, slot, 0]) if P else 0
                     sent_count += 1
                     sent_hashes.append(mix32_py(

@@ -22,6 +22,12 @@ general engine's. Configs outside the fused engine's scope (non-1024
 node counts, droppy links, route_cap, ...) record the constructor's
 refusal reason instead — the column is never silently absent.
 
+Round 9 adds a **faulted column** on the gossip row: the same
+config re-run under a mixed fault schedule (reset crash + partition +
+degradation window, faults/) through both the oracle and the general
+engine — trace AND ``fault_dropped`` counter bit-compared, so the
+chaos subsystem's parity law is pinned on the artifact hardware.
+
 Round 7 adds a **batched column**: the batch exactness law
 (engine.py ``batch=BatchSpec``) on the artifact hardware — each
 general-engine config runs a 3-world batched fleet (seeds 0/1/2) and
@@ -189,6 +195,43 @@ def main() -> int:
                 out["ok"] = False
             entry["fused_sparse"] = fent
 
+        # faulted column (round 9): the gossip row re-run under a
+        # mixed crash+partition+degradation schedule — oracle ≡
+        # engine bit-for-bit, chaos included (faults/)
+        if name == "gossip-64-drop":
+            from timewarp_tpu.faults import (FaultSchedule, LinkWindow,
+                                             NodeCrash, Partition)
+            fsched = FaultSchedule((
+                NodeCrash(3, 200_000, 900_000, reset_state=True),
+                NodeCrash(17, 100_000, 500_000),
+                Partition((tuple(range(32)), tuple(range(32, 64))),
+                          300_000, 1_200_000),
+                LinkWindow(None, None, 1_500_000, 2_500_000,
+                           scale=2.0, extra_us=1_000),
+            ))
+            with jax.default_device(cpu):
+                fo = SuperstepOracle(sc, link, faults=fsched)
+                fotrace = fo.run(20 * steps)
+            feng = JaxEngine(sc, link, faults=fsched)
+            fstate, fetrace = feng.run(steps)
+            fent = {"supported": True,
+                    "sha": trace_sha(fetrace),
+                    "fault_dropped": int(fstate.fault_dropped)}
+            try:
+                assert_traces_equal(fotrace, fetrace, "oracle-cpu",
+                                    f"faulted-engine-{platform}")
+                assert fo.fault_dropped_total == \
+                    int(fstate.fault_dropped), (
+                        f"fault_dropped diverged: oracle "
+                        f"{fo.fault_dropped_total} vs engine "
+                        f"{int(fstate.fault_dropped)}")
+                fent["equal"] = True
+            except (TraceMismatch, AssertionError) as e:
+                fent["equal"] = False
+                fent["mismatch"] = str(e)
+                out["ok"] = False
+            entry["faulted"] = fent
+
         # batched multi-world column (round 7): the batch exactness
         # law on the artifact hardware — every world of a 3-world
         # fleet sliced against the solo run with that world's seed.
@@ -231,10 +274,13 @@ def main() -> int:
         bat_word = ("batched out of scope" if not bat["supported"]
                     else "batched "
                     + ("OK" if bat["equal"] else "MISMATCH"))
+        flt = entry.get("faulted")
+        flt_word = "" if flt is None else (
+            ", faulted " + ("OK" if flt["equal"] else "MISMATCH"))
         print(f"{name}: {'OK' if entry['equal'] else 'MISMATCH'} "
               f"({entry['supersteps']} supersteps, "
               f"{entry['delivered']} delivered, {fused_word}, "
-              f"{bat_word})")
+              f"{bat_word}{flt_word})")
 
     if "--self-check" not in sys.argv:
         root = os.path.dirname(os.path.dirname(os.path.abspath(
