@@ -32,6 +32,11 @@ Configs (select with TW_BENCH_CONFIG, default ``token_ring_dense``):
   batched engine, reporting AGGREGATE delivered-msg/s/chip. Gated
   in-bench by the batch exactness law (world-b slice ≡ solo run,
   bit-for-bit) before the measured run counts.
+- ``sweep_hetero`` — the fault-tolerant sweep service (sweep/,
+  docs/sweeps.md) on a heterogeneous pack with one injected transient
+  failure: aggregate delivered-msg/s THROUGH the service (journal +
+  checkpoints included), gated by the sweep survival law (every
+  streamed result ≡ its solo run, bit-for-bit).
 
 Env knobs: TW_BENCH_CONFIG, TW_BENCH_NODES (config-default), and
 TW_BENCH_STEPS (supersteps in the measured window). ``--reps K``
@@ -411,6 +416,73 @@ def bench_gossip_100k_chaos(n, steps):
             f"@{n} nodes", delivered / dt, extra)
 
 
+def bench_sweep_hetero(n, steps):
+    """The fault-tolerant sweep service (sweep/, docs/sweeps.md) on a
+    heterogeneous pack: token-ring seed+link sweeps (one world
+    faulted, budgets differing) plus windowed burst-gossip worlds,
+    shape-bucketed onto batched engines and run under the supervision
+    loop with ONE injected transient failure (the retry path is
+    exercised every time, not just in tests). Gated by the sweep
+    survival law before the number counts: every streamed per-world
+    result record — chained trace digest + never-silent counters —
+    must be bit-identical to the solo run of that config. Reports
+    aggregate delivered-msg/s through the service (journal + atomic
+    checkpoints included — this is service throughput, not bare
+    engine throughput)."""
+    import shutil
+    import tempfile
+
+    from timewarp_tpu.sweep import SweepPack, SweepService, solo_result
+
+    n = n or 4096
+    steps = steps or 2000
+    ring = {"nodes": n, "n_tokens": max(4, n // 64), "think_us": 2000,
+            "end_us": 1 << 40, "mailbox_cap": 8}
+    gossip = {"nodes": n, "fanout": 4, "burst": True,
+              "end_us": 400_000, "mailbox_cap": 16, "think_us": 700}
+    pack = SweepPack.from_json([
+        {"id": "ring-s0", "scenario": "token-ring", "params": ring,
+         "link": "uniform:1000:5000", "seed": 0, "budget": steps},
+        {"id": "ring-s1", "scenario": "token-ring", "params": ring,
+         "link": "uniform:2000:7000", "seed": 1,
+         "budget": max(steps // 2, 8)},
+        {"id": "ring-chaos", "scenario": "token-ring", "params": ring,
+         "link": "uniform:1000:5000", "seed": 2, "budget": steps,
+         "faults": "crash:3:5ms:40ms:reset; partition:0-1|2-3:10ms:30ms"},
+        {"id": "gos-s0", "scenario": "gossip", "params": gossip,
+         "link": "quantize:1000:uniform:3000:9000", "seed": 3,
+         "window": "auto", "budget": steps},
+        {"id": "gos-s1", "scenario": "gossip", "params": gossip,
+         "link": "quantize:1000:uniform:4000:8000", "seed": 4,
+         "window": "auto", "budget": steps},
+    ])
+    d = tempfile.mkdtemp(prefix="tw_sweep_bench_")
+    try:
+        t0 = time.perf_counter()
+        svc = SweepService(pack, d, chunk=max(64, steps // 8),
+                           lint="off", inject="fail:2")
+        report = svc.run()
+        dt = time.perf_counter() - t0
+        assert report.ok, f"sweep failed: {report.to_json()}"
+        assert report.retries >= 1, \
+            "the injected transient failure never exercised the retry path"
+        # the survival law, world by world (solo re-runs — the gate
+        # deliberately costs a second pass)
+        for rid, res in report.done.items():
+            want = solo_result(pack.by_id(rid), lint="off")
+            assert want == res, (
+                f"sweep survival law violated for {rid}:\n"
+                f"  solo:     {want}\n  streamed: {res}")
+        delivered = sum(r["delivered"] for r in report.done.values())
+        extra = {"worlds": report.total, "buckets": report.buckets,
+                 "retries": report.retries, "splits": report.splits}
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+    return (f"heterogeneous sweep service (retry + stream + survival "
+            f"law) aggregate delivered-messages/sec @{n} nodes",
+            delivered / dt, extra)
+
+
 def bench_praos_1m_b4(n, steps):
     """Praos as a 4-world fleet sweeping BOTH seed and link model per
     world (lognormal median 18/20/22/24 ms — a Monte-Carlo link study
@@ -531,6 +603,7 @@ CONFIGS = {
     "praos_1m": bench_praos_1m,
     "praos_1m_fused": bench_praos_1m_fused,
     "praos_1m_b4": bench_praos_1m_b4,
+    "sweep_hetero": bench_sweep_hetero,
 }
 
 #: --smoke shapes: every config tiny enough for a CPU CI runner, all
@@ -548,6 +621,7 @@ SMOKE = {
     "praos_1m": (2048, 24),
     "praos_1m_fused": (2048, 24),
     "praos_1m_b4": (1024, 24),
+    "sweep_hetero": (256, 96),
 }
 
 

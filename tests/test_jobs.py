@@ -217,6 +217,99 @@ def test_safe_add_after_close_body_never_runs(runner):
 
 
 @par()
+def test_with_timeout_force_clears_stragglers_callback_once(runner):
+    """The watchdog contract under BOTH interpreters (Job.hs:147-152):
+    ALL stragglers are Force-cleared at the deadline, and the user
+    callback runs exactly once — not once per straggler, not again
+    when a later event re-checks the table."""
+    log = []
+    jc = JobCurator()
+
+    def stubborn(i):
+        def prog():
+            # safe jobs that ignore interruption entirely
+            yield Wait(80_000)
+            log.append(f"s{i}-done")
+        return prog
+
+    def on_timeout():
+        log.append("cb")
+        yield GetTime()
+
+    def main():
+        for i in range(3):
+            yield from jc.add_safe_thread_job(stubborn(i))
+        assert jc.job_count == 3
+        yield from jc.stop_all_jobs(WithTimeout(4_000, on_timeout))
+        # the deadline (not job completion) unblocked us: every
+        # straggler was Force-cleared while its body still ran
+        assert jc.job_count == 0
+        assert not any(e.endswith("-done") for e in log)
+        assert log.count("cb") == 1
+        return "done"
+
+    assert runner(main) == "done"
+
+
+@par()
+def test_with_timeout_callback_skipped_when_jobs_finish_first(runner):
+    """The callback fires only when the deadline actually finds
+    stragglers: jobs that were already done (here: Plain-killed
+    thread jobs) must NOT trigger it — zero callbacks, not one."""
+    cb = []
+    jc = JobCurator()
+
+    def worker():
+        yield Wait(50_000)
+
+    def on_timeout():
+        cb.append(1)
+        yield GetTime()
+
+    def main():
+        yield from jc.add_thread_job(worker)
+        # Plain pass kills the worker immediately; the watchdog is
+        # still armed and must find an empty table at its deadline
+        yield from jc.stop_all_jobs(WithTimeout(2_000, on_timeout))
+        assert jc.job_count == 0
+        yield Wait(5_000)   # sail past the deadline
+        assert cb == []
+        return "done"
+
+    assert runner(main) == "done"
+
+
+@par()
+def test_with_timeout_rearmed_watchdogs_fire_callback_once_total(runner):
+    """Two armed WithTimeout watchdogs over one straggler: the first
+    deadline Force-clears the table, so the second watchdog finds no
+    jobs and must not re-run its callback — exactly one firing total
+    even under repeated escalation."""
+    log = []
+    jc = JobCurator()
+
+    def stubborn():
+        yield Wait(90_000)
+        log.append("stubborn-done")
+
+    def on_timeout():
+        log.append("cb")
+        yield GetTime()
+
+    def main():
+        yield from jc.add_safe_thread_job(stubborn)
+        yield from jc.interrupt_all_jobs(WithTimeout(3_000, on_timeout))
+        yield from jc.interrupt_all_jobs(WithTimeout(6_000, on_timeout))
+        yield Wait(9_000)   # both deadlines pass
+        assert jc.job_count == 0
+        assert log.count("cb") == 1
+        assert "stubborn-done" not in log
+        return "done"
+
+    assert runner(main) == "done"
+
+
+@par()
 def test_with_timeout_on_already_interrupted_curator(runner):
     """Reference contract (Job.hs:147-152): interruptAllJobs WithTimeout
     forks its Force watchdog even when the curator was already

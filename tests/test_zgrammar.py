@@ -1,0 +1,122 @@
+"""Fuzz-style error-path coverage for the CLI spec grammars.
+
+The never-silent contract extends to *parsing*: every malformed
+``--link`` / ``--faults`` spec must die with a message naming the
+grammar (cli.LINK_GRAMMAR / faults.schedule.FAULT_GRAMMAR), never
+escape as a raw IndexError/ValueError traceback. These tests sweep a
+corpus of malformed specs — every historical parse bug class plus
+adversarial shapes (empty fields, wrong arity, non-numeric values,
+nested-spec damage) — and assert the contract for each.
+"""
+
+import pytest
+
+from timewarp_tpu.cli import LINK_GRAMMAR, parse_link
+from timewarp_tpu.faults.schedule import FAULT_GRAMMAR, parse_faults
+
+BAD_LINKS = [
+    "",                          # empty spec
+    ":",                         # empty kind
+    "bogus:3",                   # unknown kind
+    "fixed",                     # missing delay
+    "fixed:",                    # empty delay
+    "fixed:abc",                 # non-numeric delay
+    "fixed:1:2",                 # excess params
+    "uniform:1",                 # missing HI
+    "uniform:a:b",               # non-numeric bounds
+    "uniform:1:2:3",             # excess params
+    "lognormal:5",               # missing SIGMA
+    "lognormal:x:y",             # non-numeric
+    "drop",                      # bare wrapper
+    "drop:0.5",                  # wrapper without inner spec
+    "drop:0.5:",                 # empty inner spec
+    "drop:zz:fixed:5",           # non-numeric probability
+    "drop:0.1:bogus:2",          # damaged inner spec
+    "quantize",                  # bare wrapper
+    "quantize:5:",               # empty inner spec
+    "quantize:a:fixed:1",        # non-numeric grid
+    "quantize:5:uniform:1",      # damaged inner arity
+    "never:1",                   # never takes no params
+]
+
+BAD_FAULTS = [
+    "",                          # empty spec
+    ";;",                        # only separators
+    "crash",                     # no fields
+    "crash:1",                   # missing window
+    "crash:1:2",                 # missing UP
+    "crash:1:2:3:4",             # 5th field must be 'reset'
+    "crash:1:2:3:resetX",        # damaged reset token
+    "crash:x:2:3",               # non-numeric node
+    "crash:-1:2:3",              # negative node
+    "crash:1:2q:3",              # bad time suffix
+    "partition:0|1",             # missing window
+    "partition:0:1:2",           # one group cuts nothing
+    "partition:all|1:0:5",       # 'all' group is not explicit
+    "partition:0-|1:0:5",        # damaged range
+    "partition:3-1|5:0:5",       # empty range
+    "partition:0+0|1:0:5",       # node in two... (duplicate in group)
+    "degrade:1:2:3",             # missing fields
+    "degrade:all:all:0:5:x",     # non-numeric scale
+    "degrade:all:all:0:5:-1",    # scale must be > 0
+    "degrade:all:all:0:5:1.0:-3",  # negative extra
+    "skew:1",                    # missing offset
+    "skew:a:5",                  # non-numeric node
+    "bogus:1:2",                 # unknown kind
+    "crash:1:2:3,crash:2:3:4",   # comma is not the separator
+]
+
+
+@pytest.mark.parametrize("spec", BAD_LINKS)
+def test_malformed_link_specs_name_the_grammar(spec):
+    with pytest.raises(SystemExit) as ei:
+        parse_link(spec)
+    msg = str(ei.value)
+    assert "grammar" in msg and LINK_GRAMMAR in msg, \
+        f"{spec!r} died without naming the grammar: {msg}"
+
+
+@pytest.mark.parametrize("spec", BAD_LINKS)
+def test_malformed_link_specs_never_raw_traceback(spec):
+    # the contract's other half: the ONLY exception species is the
+    # grammar-named SystemExit — no IndexError/ValueError escapes
+    try:
+        parse_link(spec)
+    except SystemExit:
+        pass
+    else:
+        pytest.fail(f"{spec!r} parsed without error")
+
+
+@pytest.mark.parametrize("spec", BAD_FAULTS)
+def test_malformed_fault_specs_name_the_grammar(spec):
+    with pytest.raises(SystemExit) as ei:
+        parse_faults(spec)
+    msg = str(ei.value)
+    assert "grammar" in msg and FAULT_GRAMMAR in msg, \
+        f"{spec!r} died without naming the grammar: {msg}"
+
+
+@pytest.mark.parametrize("spec", BAD_FAULTS)
+def test_malformed_fault_specs_never_raw_traceback(spec):
+    try:
+        parse_faults(spec)
+    except SystemExit:
+        pass
+    else:
+        pytest.fail(f"{spec!r} parsed without error")
+
+
+def test_good_specs_still_parse():
+    """The fuzz corpus must not have been 'fixed' by rejecting valid
+    grammar: canonical good specs from the docs still parse."""
+    from timewarp_tpu.net.delays import Quantize, WithDrop
+    assert parse_link("fixed:500").delay == 500
+    assert isinstance(parse_link("drop:0.25:quantize:1000:uniform:1000:5000"),
+                      WithDrop)
+    assert isinstance(parse_link("quantize:1000:lognormal:5000:0.5"),
+                      Quantize)
+    sched = parse_faults(
+        "crash:3:5s:9s:reset; partition:0-3|4-7:2s:4s; "
+        "degrade:all:all:1s:2s:4.0:10ms; skew:2:250")
+    assert len(sched.events) == 4

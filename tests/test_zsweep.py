@@ -1,0 +1,425 @@
+"""The fault-tolerant sweep service (timewarp_tpu/sweep/).
+
+The law under test is the **sweep survival law**: every world's
+streamed result record (chained trace digest + never-silent counters)
+is bit-identical to the solo run of that config — regardless of shape
+bucketing, per-world budgets, injected transient failures, watchdog
+timeouts, OOM bucket splits, or a mid-sweep kill + resume. Plus the
+engine-side guarantees underneath it (per-world budget vectors through
+the pow2-padded scan; the run_stream quiesce callbacks) and the
+crash-safety of the journal/checkpoint layer.
+
+(Named test_zsweep to sort after the existing suite — the tier-1 time
+window truncates, so new tests must not displace existing dots.)
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from timewarp_tpu.interp.jax_engine.batched import BatchSpec, world_slice
+from timewarp_tpu.interp.jax_engine.engine import JaxEngine
+from timewarp_tpu.models.token_ring import token_ring, token_ring_links
+from timewarp_tpu.sweep import (SweepConfigError, SweepJournal, SweepPack,
+                                SweepService, plan_buckets, solo_result)
+from timewarp_tpu.sweep.service import SweepKilled
+from timewarp_tpu.trace.events import (assert_states_equal,
+                                       assert_traces_equal)
+
+# -- the shared heterogeneous pack (kept tiny: CPU CI) ---------------------
+
+_RING = {"nodes": 20, "n_tokens": 3, "think_us": 2000, "end_us": 70000,
+         "mailbox_cap": 8}
+_GOSSIP = {"nodes": 24, "fanout": 3, "burst": True, "end_us": 90000,
+           "mailbox_cap": 16, "think_us": 700}
+
+PACK = SweepPack.from_json([
+    # one shape bucket: seed + link sweep + one faulted world + one
+    # short budget, all through a single batched executable
+    {"id": "ring-a", "scenario": "token-ring", "params": _RING,
+     "link": "uniform:1000:5000", "seed": 0, "budget": 60},
+    {"id": "ring-b", "scenario": "token-ring", "params": _RING,
+     "link": "uniform:2000:7000", "seed": 3, "budget": 90},
+    {"id": "ring-c", "scenario": "token-ring", "params": _RING,
+     "link": "uniform:1000:5000", "seed": 7, "budget": 25,
+     "faults": "crash:3:5ms:20ms"},
+    # a different family and window — its own bucket
+    {"id": "gos-a", "scenario": "gossip", "params": _GOSSIP,
+     "link": "quantize:1000:uniform:3000:9000", "seed": 2,
+     "window": "auto", "budget": 100},
+])
+
+_SOLO = {}
+
+
+def solo(run_id):
+    """Solo results cached across tests (each one compiles an engine)."""
+    if run_id not in _SOLO:
+        _SOLO[run_id] = solo_result(PACK.by_id(run_id), lint="off")
+    return _SOLO[run_id]
+
+
+def assert_survival_law(report):
+    assert report.ok, report.to_json()
+    for rid, res in report.done.items():
+        assert solo(rid) == res, (
+            f"sweep survival law violated for {rid}:\n"
+            f"  solo:     {solo(rid)}\n  streamed: {res}")
+
+
+def run_service(tmp_path, name, **kw):
+    svc = SweepService(PACK, str(tmp_path / name), chunk=16,
+                       lint="off", **kw)
+    return svc, svc.run()
+
+
+# -- engine-side: per-world budgets + streaming driver ---------------------
+
+def _ring_engine(seeds):
+    sc = token_ring(24, n_tokens=3, think_us=2_000, bootstrap_us=1000,
+                    end_us=80_000, with_observer=True, mailbox_cap=16)
+    link = token_ring_links(24)
+    return (JaxEngine(sc, link, batch=BatchSpec(seeds=seeds),
+                      lint="off"),
+            sc, link)
+
+
+def test_per_world_budget_vector_matches_solo():
+    """run([b0, b1, b2]): world b freezes at ITS budget, bit-identical
+    to the solo run with that budget — the heterogeneous-budget half
+    of the bucket machinery."""
+    eng, sc, link = _ring_engine((0, 1, 5))
+    budgets = [40, 65, 20]
+    final, traces = eng.run(np.asarray(budgets))
+    for b, s in enumerate((0, 1, 5)):
+        solo_final, solo_trace = JaxEngine(sc, link, seed=s,
+                                           lint="off").run(budgets[b])
+        assert_traces_equal(solo_trace, traces[b], "solo", f"world{b}")
+        assert_states_equal(solo_final, world_slice(final, b),
+                            f"world {b}")
+
+
+def test_budget_vector_guards():
+    eng, sc, link = _ring_engine((0, 1))
+    with pytest.raises(ValueError, match="one int per world"):
+        eng.run(np.asarray([10, 10, 10]))
+    solo_eng = JaxEngine(sc, link, seed=0, lint="off")
+    with pytest.raises(ValueError, match="batch=BatchSpec"):
+        solo_eng.run(np.asarray([10]))
+
+
+def test_run_stream_quiesce_callbacks_and_trace_parity():
+    """run_stream: chunked execution with per-world quiesce callbacks
+    — fires exactly once per world, and the accumulated traces/final
+    state equal the one-shot run bit-for-bit."""
+    eng, sc, link = _ring_engine((0, 1, 5))
+    budgets = [40, 65, 20]
+    full_final, full_traces = eng.run(np.asarray(budgets))
+    quiesced = []
+    st, traces = eng.run_stream(budgets, chunk=16,
+                                on_quiesce=lambda b, s: quiesced.append(b))
+    assert sorted(quiesced) == [0, 1, 2]
+    assert len(quiesced) == len(set(quiesced))
+    for b in range(3):
+        assert_traces_equal(full_traces[b], traces[b], "run", "stream")
+    assert_states_equal(full_final, st, "stream final")
+
+
+# -- planning --------------------------------------------------------------
+
+def test_plan_buckets_shape_grouping():
+    buckets = plan_buckets(PACK.configs)
+    by_id = {b.bucket_id: b for b in buckets}
+    # the three ring worlds share one bucket (same scenario shape,
+    # same link STRUCTURE — bounds sweep per world; the fault schedule
+    # rides as a FaultFleet); gossip is its own shape
+    assert sorted(len(b.configs) for b in buckets) == [1, 3]
+    ring = next(b for b in buckets if b.B == 3)
+    assert ring.run_ids == ("ring-a", "ring-b", "ring-c")
+    assert list(ring.budgets) == [60, 90, 25]
+    del by_id
+
+
+def test_plan_buckets_split_on_structure_and_window():
+    cfgs = SweepPack.from_json([
+        {"id": "a", "scenario": "token-ring", "params": _RING,
+         "link": "uniform:1000:5000"},
+        {"id": "b", "scenario": "token-ring", "params": _RING,
+         "link": "drop:0.5:uniform:1000:5000"},   # structure differs
+        {"id": "c", "scenario": "token-ring",
+         "params": {**_RING, "nodes": 32}},        # shape differs
+        {"id": "d", "scenario": "token-ring", "params": _RING,
+         "link": "uniform:1000:5000", "window": 1000},  # window differs
+    ]).configs
+    assert len(plan_buckets(cfgs)) == 4
+
+
+def test_run_config_validation_is_loud():
+    with pytest.raises(SweepConfigError, match="unknown scenario"):
+        SweepPack.from_json([{"id": "x", "scenario": "nope"}])
+    with pytest.raises(SweepConfigError, match="takes no param"):
+        SweepPack.from_json([{"id": "x", "scenario": "gossip",
+                              "params": {"fanouts": 3}}])
+    with pytest.raises(SweepConfigError, match="duplicate run_id"):
+        SweepPack.from_json([{"id": "x", "scenario": "gossip"},
+                             {"id": "x", "scenario": "gossip"}])
+    with pytest.raises(SweepConfigError, match="grammar"):
+        SweepPack.from_json([{"id": "x", "scenario": "gossip",
+                              "link": "bogus:1"}]).configs[0].parse_link()
+    with pytest.raises(SweepConfigError, match="must be an integer"):
+        # validated, not coerced: int(50.9) would silently truncate
+        SweepPack.from_json([{"id": "x", "scenario": "gossip",
+                              "budget": 50.9}])
+    with pytest.raises(SweepConfigError, match="inject"):
+        # a malformed inject spec is a catchable library error, not a
+        # process-killing SystemExit (the CLI converts it)
+        SweepService(PACK, "/tmp/never-created", inject="fail")
+
+
+# -- the service: survival law under chaos ---------------------------------
+
+def test_sweep_survival_law_with_injected_transient_retry(tmp_path):
+    """A transient chunk failure retries from the last checkpoint and
+    the sweep completes with every digest solo-identical; the journal
+    streams one world_done per world (as worlds quiesce, not at fleet
+    end) and records the retry."""
+    svc, report = run_service(tmp_path, "j1", inject="fail:2")
+    assert report.retries == 1
+    assert_survival_law(report)
+    scan = SweepJournal(str(tmp_path / "j1")).scan()
+    done_events = [e for e in scan.events if e.get("ev") == "world_done"]
+    assert sorted(e["result"]["run_id"] for e in done_events) == \
+        sorted(c.run_id for c in PACK.configs)
+    assert scan.retries == 1
+
+
+def test_sweep_kill_mid_bucket_then_resume_exactly(tmp_path):
+    """The acceptance scenario: kill the sweep mid-bucket, resume,
+    assert zero worlds lost or double-journaled and every digest
+    solo-identical."""
+    jd = str(tmp_path / "j2")
+    svc = SweepService(PACK, jd, chunk=16, lint="off", inject="die:3")
+    with pytest.raises(SweepKilled):
+        svc.run()
+    mid = SweepJournal(jd).scan()
+    assert 0 < len(mid.done) < len(PACK.configs), (
+        "the kill must land mid-sweep: some worlds streamed, some "
+        f"pending (got {sorted(mid.done)})")
+    svc2 = SweepService.resume(jd, chunk=16, lint="off")
+    report = svc2.run()
+    assert_survival_law(report)
+    scan = SweepJournal(jd).scan()
+    ids = [e["result"]["run_id"] for e in scan.events
+           if e.get("ev") == "world_done"]
+    assert sorted(ids) == sorted(set(ids)), "world double-journaled"
+    assert sorted(ids) == sorted(c.run_id for c in PACK.configs), \
+        "world lost across the kill/resume boundary"
+
+
+def test_sweep_oom_split_down_to_smaller_buckets(tmp_path):
+    """Injected device OOM mid-bucket: the bucket splits in half from
+    its checkpoint (journaled), the sweep completes, and split worlds
+    still satisfy the survival law."""
+    jd = str(tmp_path / "j3")
+    svc, report = run_service(tmp_path, "j3", inject="oom:2")
+    assert report.splits >= 1
+    assert_survival_law(report)
+    scan = SweepJournal(jd).scan()
+    assert scan.splits, "bucket_split must be journaled for resume"
+
+
+def test_sweep_terminal_failure_is_loud_not_silent(tmp_path, caplog):
+    """Retries exhausted: the bucket's unfinished worlds journal
+    world_failed, land in report.failed, and log at ERROR — while
+    every other bucket still completes."""
+    import logging
+    jd = str(tmp_path / "j4")
+    svc = SweepService(PACK, jd, chunk=16, lint="off",
+                       max_retries=1, backoff_us=1_000,
+                       inject="fail:1;fail:2")  # both attempts die
+    with caplog.at_level(logging.ERROR, logger="timewarp.sweep"):
+        report = svc.run()
+    assert not report.ok
+    assert set(report.failed) == {"ring-a", "ring-b", "ring-c"}
+    assert report.done, "the surviving bucket must still complete"
+    assert solo("gos-a") == report.done["gos-a"]
+    assert any("TERMINALLY FAILED" in r.message for r in caplog.records)
+    scan = SweepJournal(jd).scan()
+    assert set(scan.failed) == set(report.failed)
+    # terminal failures stay terminal across resume (documented):
+    # nothing left to run, report reflects the failure
+    report2 = SweepService.resume(jd, chunk=16, lint="off").run()
+    assert set(report2.failed) == set(report.failed) and not report2.ok
+
+
+def test_sweep_watchdog_abandons_wedged_attempt(tmp_path):
+    """The per-bucket WithTimeout watchdog: a wedged chunk (blocking
+    in the executor, never yielding) is abandoned AT the deadline —
+    the attempt returns promptly flagged timed_out (-> transient
+    retry in the supervisor), the attempt's epoch is invalidated so
+    the zombie thread loses every write path, and the supervisor
+    never blocks on the wedged thread. Stubbed runner: the timing
+    here must be deterministic, not a race against XLA compile
+    times."""
+    import time
+    from types import SimpleNamespace
+
+    from timewarp_tpu.interp.aio.timed import run_real_time
+    from timewarp_tpu.manage.jobs import JobCurator
+
+    class Wedged:
+        bucket = SimpleNamespace(bucket_id="w0", B=1, configs=(),
+                                 run_ids=())
+        attempts = 0
+        epoch = 0
+        abandoned = False
+        calls = 0
+
+        def begin_attempt(self):
+            self.epoch += 1
+            return self.epoch
+
+        def abandon(self, epoch):
+            if self.epoch == epoch:
+                self.epoch += 1
+                self.abandoned = True
+
+        def prepare(self, epoch=None):
+            pass
+
+        def step(self, epoch=None):
+            self.calls += 1
+            time.sleep(0.6)      # wedged well past the deadline
+            raise RuntimeError("zombie woke up")
+
+    svc = SweepService(PACK, str(tmp_path / "j5"), lint="off",
+                       bucket_timeout_us=120_000, grace_us=30_000)
+    wedge = Wedged()
+    res = {}
+
+    def prog():
+        # timed INSIDE the loop: run_real_time's teardown joins the
+        # executor (and so the zombie's sleep) after main returns
+        t0 = time.monotonic()
+        out = yield from svc._attempt(JobCurator(), wedge)
+        res["elapsed"] = time.monotonic() - t0
+        res["out"] = out
+
+    run_real_time(prog)
+    out = res["out"]
+    assert out.timed_out and not out.ok and out.error is None
+    assert wedge.abandoned, ("the zombie's attempt epoch must be "
+                             "invalidated so it can never write")
+    assert wedge.calls == 1
+    assert res["elapsed"] < 0.55, (
+        f"the watchdog must unblock at the 0.12 s deadline, not wait "
+        f"out the 0.6 s wedge (took {res['elapsed']:.2f} s)")
+
+
+def test_stale_attempt_epoch_bars_zombie_writes(tmp_path):
+    """The zombie-write guard at the unit level: a runner whose
+    attempt epoch was abandoned (watchdog) raises StaleAttempt from
+    every blocking entry point instead of journaling, checkpointing,
+    or mutating state — a retried bucket can never be corrupted by
+    its abandoned predecessor."""
+    from timewarp_tpu.sweep.runner import BucketRunner, StaleAttempt
+    bucket = plan_buckets(PACK.configs)[0]
+    r = BucketRunner(bucket, SweepJournal(str(tmp_path / "jz")), {},
+                     lint="off", chunk=8)
+    epoch = r.begin_attempt()
+    r.abandon(epoch)
+    with pytest.raises(StaleAttempt):
+        r.prepare(epoch)
+    with pytest.raises(StaleAttempt):
+        r.step(epoch)
+    assert not os.path.exists(str(tmp_path / "jz" / "journal.jsonl"))
+    # the next attempt generation is clean
+    assert r.begin_attempt() > epoch
+
+
+# -- journal / checkpoint robustness ---------------------------------------
+
+def test_checkpoint_write_is_atomic_and_corrupt_load_actionable(tmp_path):
+    """Satellite: utils/checkpoint.py — no temp droppings after a
+    save, and a truncated/garbage checkpoint fails with an error
+    naming the file and the expected layout (never a raw
+    unpickling/zip error)."""
+    from timewarp_tpu.utils.checkpoint import load_state, save_state
+    eng, _, _ = _ring_engine((0, 1))
+    st = eng.init_state()
+    path = str(tmp_path / "ck.npz")
+    save_state(path, st, meta={"k": 1})
+    assert os.listdir(tmp_path) == ["ck.npz"], "temp file leaked"
+    loaded, meta = load_state(path, eng.init_state())
+    assert meta == {"k": 1}
+
+    # truncate: the classic torn-file shape
+    blob = open(path, "rb").read()
+    open(path, "wb").write(blob[:len(blob) // 3])
+    with pytest.raises(ValueError) as ei:
+        load_state(path, eng.init_state())
+    msg = str(ei.value)
+    assert path in msg and "expected layout" in msg and "leaf_0" in msg
+
+    # outright garbage
+    open(path, "wb").write(b"not a checkpoint at all")
+    with pytest.raises(ValueError, match="truncated or corrupt"):
+        load_state(path, eng.init_state())
+
+    # missing file stays a plain FileNotFoundError (not "corrupt")
+    with pytest.raises(FileNotFoundError):
+        load_state(str(tmp_path / "absent.npz"), eng.init_state())
+
+
+def test_journal_tolerates_torn_tail_rejects_midfile_damage(tmp_path):
+    from timewarp_tpu.sweep.journal import SweepJournalError
+    j = SweepJournal(str(tmp_path / "jj"))
+    j.append({"ev": "pack", "sha": "x", "worlds": 1})
+    j.append({"ev": "bucket_start", "bucket": "b0", "attempt": 1})
+    j.close()
+    # a crash can tear the last line: dropped with a warning
+    with open(j.path, "a") as f:
+        f.write('{"ev": "world_done", "result": {"run_id"')
+    assert len(j.records()) == 2
+    # damage anywhere else is external corruption: loud
+    lines = open(j.path).read().splitlines()
+    lines[0] = lines[0][:10]
+    open(j.path, "w").write("\n".join(lines) + "\n")
+    with pytest.raises(SweepJournalError, match="corrupt mid-file"):
+        j.records()
+
+
+def test_journal_refuses_conflicting_double_results(tmp_path):
+    from timewarp_tpu.sweep.journal import SweepJournalError
+    j = SweepJournal(str(tmp_path / "jj2"))
+    j.append({"ev": "world_done", "result": {"run_id": "w0", "d": 1}})
+    j.append({"ev": "world_done", "result": {"run_id": "w0", "d": 2}})
+    j.close()
+    with pytest.raises(SweepJournalError, match="double-journaled"):
+        j.scan()
+
+
+def test_resume_refuses_a_different_pack(tmp_path):
+    from timewarp_tpu.sweep.journal import SweepJournalError
+    jd = str(tmp_path / "j6")
+    run_service(tmp_path, "j6")
+    other = SweepPack.from_json([
+        {"id": "only", "scenario": "token-ring", "params": _RING,
+         "budget": 10}])
+    svc = SweepService(other, jd, lint="off")
+    with pytest.raises(SweepJournalError, match="different pack"):
+        svc.run()
+
+
+def test_sweep_status_cli_line(tmp_path, capsys):
+    """`sweep status` summarizes the journal without running."""
+    from timewarp_tpu.sweep.cli import sweep_main
+    jd = str(tmp_path / "j7")
+    run_service(tmp_path, "j7")
+    assert sweep_main(["status", "--journal", jd]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["worlds"] == len(PACK.configs)
+    assert out["completed"] == len(PACK.configs)
+    assert out["pending"] == 0 and out["failed"] == []

@@ -13,6 +13,11 @@ Usage::
 Prints one JSON summary line; ``--trace-csv`` dumps the superstep
 trace; ``--save`` / ``--resume`` checkpoint through
 utils/checkpoint.py.
+
+Subcommands: ``timewarp-tpu lint`` (the scenario sanitizer sweep,
+below) and ``timewarp-tpu sweep run|resume|status`` (the
+fault-tolerant sweep service over heterogeneous world packs —
+sweep/cli.py, docs/sweeps.md).
 """
 
 from __future__ import annotations
@@ -397,6 +402,10 @@ def main(argv=None) -> int:
     argv = list(argv)
     if argv and argv[0] == "lint":
         return lint_main(argv[1:])
+    if argv and argv[0] == "sweep":
+        # the fault-tolerant sweep service (sweep/): run|resume|status
+        from .sweep.cli import sweep_main
+        return sweep_main(argv[1:])
     p = argparse.ArgumentParser(
         prog="timewarp_tpu",
         description="Run a distributed-system scenario under an "

@@ -60,12 +60,26 @@ def padded_scan(step_all, st, n_pad: int, max_steps):
     index >= ``max_steps`` (traced) compute and discard their
     superstep, freezing the carry and zeroing the trace row
     (valid=False, filtered host-side). ``step_all`` is the engine's
-    one-driver-step hook ``(carry, with_trace) -> (carry', yrow)``."""
+    one-driver-step hook ``(carry, with_trace) -> (carry', yrow)``.
+
+    ``max_steps`` may also be an int64[B] vector of per-world budgets
+    (batched engines only — the sweep service's heterogeneous-budget
+    buckets, sweep/): world b freezes leaf-wise after its own budget,
+    exactly as the quiescence mask freezes it, so a short-budget world
+    stays bit-identical to its solo run while sibling worlds keep
+    stepping. Trace rows are [B]-leading under the batch, so the same
+    mask zeroes only the frozen worlds' rows."""
+    per_world = getattr(max_steps, "ndim", 0) == 1
+
     def body(carry, i):
         new, y = step_all(carry, True)
-        run = i < max_steps
-        carry = jax.tree.map(
-            lambda a, b: jnp.where(run, b, a), carry, new)
+        run = i < max_steps          # bool[] — or bool[B] per world
+
+        def mask(a, b):
+            r = run.reshape(run.shape + (1,) * (b.ndim - 1)) \
+                if per_world else run
+            return jnp.where(r, b, a)
+        carry = jax.tree.map(mask, carry, new)
         y = jax.tree.map(
             lambda x: jnp.where(run, x, jnp.zeros_like(x)), y)
         return carry, y

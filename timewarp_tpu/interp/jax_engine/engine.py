@@ -1291,15 +1291,42 @@ class JaxEngine:
                 list(zip(*(c[m, b] for c in cols)))))
         return traces
 
-    def run(self, max_steps: int,
+    def _coerce_budget(self, max_steps):
+        """Normalize a step budget for the traced drivers: one int
+        (solo, or fleet-wide), or — batched engines only — one budget
+        per world (the sweep service's heterogeneous buckets, sweep/).
+        Returns ``(traced_budget, top)`` where ``top`` is the host int
+        the pow2 scan padding is derived from."""
+        if isinstance(max_steps, (int, np.integer)):
+            return jnp.asarray(max_steps, jnp.int64), int(max_steps)
+        budgets = np.asarray(max_steps)
+        if self.batch is None:
+            raise ValueError(
+                "per-world step budgets need batch=BatchSpec; a solo "
+                f"run takes one int budget (got shape {budgets.shape})")
+        if budgets.shape != (self.batch.B,) or budgets.dtype.kind not in "iu":
+            raise ValueError(
+                f"per-world budgets must be one int per world, shape "
+                f"[{self.batch.B}]; got shape {budgets.shape} dtype "
+                f"{budgets.dtype}")
+        if budgets.size and int(budgets.min()) < 0:
+            raise ValueError("step budgets must be >= 0")
+        top = int(budgets.max()) if budgets.size else 0
+        return jnp.asarray(budgets, jnp.int64), top
+
+    def run(self, max_steps,
             state: Optional[EngineState] = None
             ) -> Tuple[EngineState, SuperstepTrace]:
         """Execute up to ``max_steps`` supersteps; returns final state
         and the trace of the supersteps that actually fired — batched
-        engines return a **list** of per-world traces."""
+        engines return a **list** of per-world traces. Batched engines
+        also accept a length-B sequence of per-world budgets: world b
+        freezes after its own budget, bit-identical to the solo run
+        with that budget (the sweep service's heterogeneous-budget
+        buckets — padded_scan in common.py)."""
         st = state if state is not None else self.init_state()
-        final, ys = self._run_scan(st, _scan_pad(max_steps),
-                                   jnp.asarray(max_steps, jnp.int64))
+        budget, top = self._coerce_budget(max_steps)
+        final, ys = self._run_scan(st, _scan_pad(top), budget)
         ys = jax.device_get(ys)
         if self.batch is not None:
             return final, self._decode_traces(ys)
@@ -1330,12 +1357,94 @@ class JaxEngine:
             self._while_cond_fn(start_steps, max_steps),
             self._while_body_fn(start_steps, max_steps), st)
 
-    def run_quiet(self, max_steps: int,
+    def run_quiet(self, max_steps,
                   state: Optional[EngineState] = None) -> EngineState:
         """Traceless driver for benchmarking: one ``while_loop``, no
-        per-step host materialization and no digest work compiled in."""
+        per-step host materialization and no digest work compiled in.
+        Accepts per-world budgets like :meth:`run` (batched only)."""
         st = state if state is not None else self.init_state()
-        return self._run_while(st, max_steps)
+        budget, _ = self._coerce_budget(max_steps)
+        return self._run_while(st, budget)
+
+    # -- streaming fleet driver (the sweep service's engine surface) -----
+
+    def world_active(self, state) -> jax.Array:
+        """Per-world liveness probe: True while world b still has a
+        pending event (batched states; a scalar for solo states) —
+        the same condition the quiet driver's while-loop tests, exposed
+        so the sweep service (sweep/) can detect quiesced worlds
+        between chunks without running a superstep."""
+        if self.batch is None:
+            return self._next_event(state) < NEVER
+        return jax.vmap(self._next_event)(state) < NEVER
+
+    def fleet_progress(self, state, budgets, start=0):
+        """Host-side fleet bookkeeping shared by every chunked driver
+        (:meth:`run_stream` here; the sweep service's BucketRunner
+        drives the same law one chunk at a time): per-world
+        ``(steps_done, remaining, active)`` where ``steps_done`` is
+        measured from ``start`` (per-world or scalar), ``remaining``
+        clips the budgets, and a world is active while it has a
+        pending event AND budget left. One implementation, so the
+        quiesce/budget law the sweep survival law leans on cannot
+        drift between drivers."""
+        steps_done = (np.asarray(jax.device_get(state.steps), np.int64)
+                      - np.asarray(start, np.int64))
+        remaining = np.maximum(np.asarray(budgets, np.int64)
+                               - steps_done, 0)
+        active = (np.asarray(jax.device_get(self.world_active(state)))
+                  & (remaining > 0))
+        return steps_done, remaining, active
+
+    def run_stream(self, budgets, state: Optional[EngineState] = None,
+                   *, chunk: int = 64, on_chunk=None, on_quiesce=None):
+        """Chunked fleet driver with per-world budgets and quiesce
+        callbacks. The fleet runs ``chunk`` supersteps at a time, each
+        world capped at its own remaining budget; by the batch
+        exactness law plus the driver resume contract this is
+        bit-identical to one uninterrupted run, and world b's rows are
+        bit-identical to its solo run. After every chunk
+        ``on_chunk(state, chunk_traces)`` fires; ``on_quiesce(b,
+        state)`` fires exactly once per world, the moment it has
+        quiesced or exhausted its budget — results stream as worlds
+        finish, not at fleet end. Returns ``(final_state,
+        per_world_traces)`` like :meth:`run`. (The sweep service's
+        BucketRunner needs chunk-level supervision — watchdog,
+        checkpoint, retry — between calls, so it drives the same
+        :meth:`fleet_progress` law one ``run`` chunk at a time rather
+        than through this loop; tests/test_zsweep.py pins the two
+        against each other.)"""
+        if self.batch is None:
+            raise ValueError(
+                "run_stream drives a fleet; solo runs use run()")
+        if chunk < 1:
+            raise ValueError(f"chunk must be >= 1, got {chunk}")
+        B = self.batch.B
+        budgets = np.broadcast_to(
+            np.asarray(budgets, np.int64), (B,)).copy()
+        if budgets.size and int(budgets.min()) < 0:
+            raise ValueError("step budgets must be >= 0")
+        st = state if state is not None else self.init_state()
+        start = np.asarray(jax.device_get(st.steps), np.int64)
+        rows = [[] for _ in range(B)]
+        emitted = np.zeros(B, bool)
+        while True:
+            _, remaining, active = self.fleet_progress(st, budgets,
+                                                       start)
+            for b in np.nonzero(~active & ~emitted)[0]:
+                emitted[int(b)] = True
+                if on_quiesce is not None:
+                    on_quiesce(int(b), st)
+            if not active.any():
+                break
+            vec = np.where(active, np.minimum(remaining, chunk), 0)
+            st, traces = self.run(vec, state=st)
+            if on_chunk is not None:
+                on_chunk(st, traces)
+            for b in range(B):
+                rows[b].extend(traces[b].row(i)
+                               for i in range(len(traces[b])))
+        return st, [SuperstepTrace.from_rows(r) for r in rows]
 
     def events(self, state: EngineState):
         """Decode the device-side event ring into host tuples —

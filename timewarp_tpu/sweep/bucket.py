@@ -1,0 +1,140 @@
+"""Shape-bucketing: pack heterogeneous configs into batched executables.
+
+A bucket is the largest set of pack configs one batched engine can
+serve (engine.py ``batch=BatchSpec``): same scenario family and
+builder params (one ``Scenario``, one compiled superstep), same link
+*structure* (:func:`~timewarp_tpu.sweep.spec.link_signature`), and the
+same solo-resolved window. Inside a bucket, worlds differ by:
+
+- **seed** — ``BatchSpec.seeds``;
+- **sweepable link values** — delay bounds / medians / sigmas /
+  quanta as ``BatchSpec.link_params`` dotted-path vectors;
+- **fault schedule** — a :class:`~timewarp_tpu.faults.schedule.
+  FaultFleet` (schedules of different lengths pad with inert rows;
+  worlds without faults run an empty schedule — result-identical to
+  no schedule at all, which is what keeps the sweep survival law's
+  solo twin honest);
+- **step budget** — a per-world budget vector through the pow2-padded
+  ``_scan_pad`` drivers (common.py ``padded_scan``), so every budget
+  in a pow2 bucket shares one executable.
+
+The plan is a *pure function of the pack* (dict-insertion order over
+the pack's config order, chunked at ``max_bucket``), so a resumed
+sweep re-derives bucket membership exactly from the journaled pack —
+no plan state needs journaling beyond splits.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .spec import (RunConfig, build_scenario, link_signature,
+                   link_sweep_params, resolve_window)
+
+__all__ = ["Bucket", "plan_buckets", "build_bucket_engine"]
+
+
+@dataclass(frozen=True)
+class Bucket:
+    """One schedulable unit: an ordered world list sharing a batched
+    executable. ``bucket_id`` is stable across resume (derived from
+    the deterministic plan; split children append ``.0``/``.1``).
+
+    ``fault_pad`` pins the fault-table row counts (crash, partition,
+    link-window) the bucket's FaultFleet must pad to. Split children
+    of a bucket that already ran carry the parent's realized pad so
+    the sliced ``restart_done`` state keeps its column count — pad
+    rows are inert, so results are identical at any pad
+    (faults/schedule.py FaultTables docstring)."""
+    bucket_id: str
+    configs: Tuple[RunConfig, ...]
+    window: int
+    fault_pad: Optional[Tuple[int, int, int]] = None
+
+    @property
+    def B(self) -> int:
+        return len(self.configs)
+
+    @property
+    def run_ids(self) -> Tuple[str, ...]:
+        return tuple(c.run_id for c in self.configs)
+
+    @property
+    def budgets(self) -> np.ndarray:
+        return np.asarray([c.budget for c in self.configs], np.int64)
+
+    def split(self) -> Tuple["Bucket", "Bucket"]:
+        """Halve the bucket (the OOM degradation path, service.py):
+        two children over the same window, ids suffixed so resume can
+        replay the split from the journal. A solo bucket cannot
+        split — the caller turns that OOM into a terminal failure."""
+        if self.B < 2:
+            raise ValueError(
+                f"bucket {self.bucket_id!r} holds one world; OOM on a "
+                "solo run cannot be split away")
+        mid = self.B // 2
+        return (Bucket(f"{self.bucket_id}.0", self.configs[:mid],
+                       self.window, self.fault_pad),
+                Bucket(f"{self.bucket_id}.1", self.configs[mid:],
+                       self.window, self.fault_pad))
+
+
+def _bucket_key(cfg: RunConfig):
+    return (cfg.family, cfg.params, link_signature(cfg.parse_link()),
+            resolve_window(cfg))
+
+
+def plan_buckets(configs, max_bucket: int = 64) -> List[Bucket]:
+    """Deterministic shape-bucketing of a pack (module docstring).
+    ``max_bucket`` caps worlds per bucket — oversize groups chunk in
+    pack order."""
+    if max_bucket < 1:
+        raise ValueError(f"max_bucket must be >= 1, got {max_bucket}")
+    groups: Dict[tuple, List[RunConfig]] = {}
+    for cfg in configs:
+        groups.setdefault(_bucket_key(cfg), []).append(cfg)
+    buckets: List[Bucket] = []
+    for key, cfgs in groups.items():
+        for i in range(0, len(cfgs), max_bucket):
+            part = tuple(cfgs[i:i + max_bucket])
+            buckets.append(Bucket(f"b{len(buckets)}", part, key[3]))
+    return buckets
+
+
+def build_bucket_engine(bucket: Bucket, *, lint: str = "warn"):
+    """One batched :class:`~timewarp_tpu.interp.jax_engine.engine.
+    JaxEngine` serving every world of the bucket. World b's seed,
+    sweepable link values, and (padded) fault schedule are exactly
+    the solo run's — the batch exactness law then carries the sweep
+    survival law."""
+    from ..faults.schedule import FaultFleet, FaultSchedule
+    from ..interp.jax_engine.batched import BatchSpec
+    from ..interp.jax_engine.engine import JaxEngine
+
+    cfgs = bucket.configs
+    sc = build_scenario(cfgs[0].family, cfgs[0].params)
+    links = [c.parse_link() for c in cfgs]
+    rows = [link_sweep_params(lk) for lk in links]
+    link_params = {path: np.asarray([r[path] for r in rows])
+                   for path in rows[0]} if rows[0] else None
+    spec = BatchSpec(seeds=tuple(c.seed for c in cfgs),
+                     link_params=link_params)
+    scheds = [c.parse_faults() or FaultSchedule(()) for c in cfgs]
+    pad = bucket.fault_pad
+    if pad is not None and tuple(pad) != (0, 0, 0):
+        # grow world 0's tables to (at least) the pinned shape; the
+        # fleet pads every other world up to the max, so the whole
+        # fleet lands on the parent's realized row counts
+        s0 = scheds[0]
+        scheds[0] = s0.padded(
+            max(pad[0], len(s0.crashes) + s0.pad[0]),
+            max(pad[1], len(s0.partitions) + s0.pad[1]),
+            max(pad[2], len(s0.link_windows) + s0.pad[2]))
+    empty = all(not s.events for s in scheds)
+    fleet = None if empty and (pad is None or tuple(pad) == (0, 0, 0)) \
+        else FaultFleet(tuple(scheds))
+    return JaxEngine(sc, links[0], window=bucket.window, batch=spec,
+                     faults=fleet, lint=lint)

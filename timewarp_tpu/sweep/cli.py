@@ -1,0 +1,167 @@
+"""``timewarp-tpu sweep run|resume|status`` — the sweep service CLI.
+
+::
+
+    timewarp-tpu sweep run pack.json --journal DIR [--chunk N]
+        [--retries K] [--backoff-us U] [--timeout-us T] [--inject S]
+        [--max-bucket B] [--verify]
+    timewarp-tpu sweep resume --journal DIR [...same knobs] [--verify]
+    timewarp-tpu sweep status --journal DIR
+
+``run`` on a fresh dir starts the sweep; on an existing dir it
+resumes (same pack only — a different pack is refused loudly).
+``resume`` needs no pack argument: the journaled copy is the truth.
+``status`` prints one JSON line of progress without running anything.
+``--verify`` re-runs every completed world solo after the sweep and
+asserts the streamed result is bit-identical — the sweep survival law
+as an executable gate (CI runs it).
+
+Exit codes: 0 = every world completed (and verified, if asked);
+1 = terminal world failures or a verification mismatch; an injected
+``die:K`` kill exits 1 with the kill message (resume then finishes
+the pack).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from .journal import SweepJournal
+from .service import SweepKilled, SweepService
+from .spec import SweepConfigError, SweepPack, solo_result
+
+__all__ = ["sweep_main"]
+
+
+def _loud(fn):
+    """Library config errors (SweepConfigError) become clean CLI
+    exits, keeping the grammar-named message without a traceback."""
+    try:
+        return fn()
+    except SweepConfigError as e:
+        raise SystemExit(str(e)) from None
+
+
+def _service_args(p: argparse.ArgumentParser) -> None:
+    p.add_argument("--journal", required=True,
+                   help="journal directory (JSONL log + checkpoints)")
+    p.add_argument("--chunk", type=int, default=64,
+                   help="supersteps per chunk between checkpoints")
+    p.add_argument("--retries", type=int, default=2,
+                   help="max retries per bucket before loud terminal "
+                        "failure")
+    p.add_argument("--backoff-us", type=int, default=50_000,
+                   help="retry backoff base (doubles per attempt)")
+    p.add_argument("--timeout-us", type=int, default=None,
+                   help="per-bucket-attempt watchdog deadline")
+    p.add_argument("--grace-us", type=int, default=500_000,
+                   help="Force-clear grace after a watchdog interrupt")
+    p.add_argument("--max-bucket", type=int, default=64,
+                   help="max worlds per batched bucket")
+    p.add_argument("--lint", default="warn",
+                   choices=["error", "warn", "off"])
+    p.add_argument("--inject", default=None,
+                   help="deterministic failure injection: fail:K | "
+                        "oom:K | die:K | hang:K:MS (';'-joined, K = "
+                        "1-based chunk call) — CI/test chaos for the "
+                        "sweep machinery itself")
+    p.add_argument("--verify", action="store_true",
+                   help="after the sweep, re-run every completed "
+                        "world solo and assert the streamed result is "
+                        "bit-identical (the sweep survival law)")
+
+
+def _kw(args) -> dict:
+    return dict(chunk=args.chunk, max_retries=args.retries,
+                backoff_us=args.backoff_us,
+                bucket_timeout_us=args.timeout_us,
+                grace_us=args.grace_us, max_bucket=args.max_bucket,
+                lint=args.lint, inject=args.inject)
+
+
+def _finish(svc: SweepService, verify: bool) -> int:
+    try:
+        report = svc.run()
+    except SweepKilled as e:
+        print(json.dumps({"sweep": "killed", "error": str(e)}))
+        return 1
+    out = report.to_json()
+    if verify:
+        mismatches = []
+        for rid, res in sorted(report.done.items()):
+            want = solo_result(svc.pack.by_id(rid), lint="off")
+            if want != res:
+                mismatches.append(
+                    {"run_id": rid, "solo": want, "streamed": res})
+        out["verified"] = len(report.done) - len(mismatches)
+        if mismatches:
+            out["verify_mismatches"] = mismatches
+            print(json.dumps(out))
+            sys.stderr.write(
+                "sweep survival law VIOLATED: streamed results "
+                "diverge from solo runs\n")
+            return 1
+    print(json.dumps(out))
+    return 0 if report.ok else 1
+
+
+def _run(argv) -> int:
+    p = argparse.ArgumentParser(
+        prog="timewarp-tpu sweep run",
+        description="Run (or resume, on an existing journal) a pack.")
+    p.add_argument("pack", help="pack file: JSON list (or JSONL) of "
+                   "run configs — see docs/sweeps.md")
+    _service_args(p)
+    args = p.parse_args(argv)
+    svc = _loud(lambda: SweepService(SweepPack.load(args.pack),
+                                     args.journal, **_kw(args)))
+    return _finish(svc, args.verify)
+
+
+def _resume(argv) -> int:
+    p = argparse.ArgumentParser(
+        prog="timewarp-tpu sweep resume",
+        description="Resume a killed sweep from its journal dir.")
+    _service_args(p)
+    args = p.parse_args(argv)
+    svc = _loud(lambda: SweepService.resume(args.journal, **_kw(args)))
+    return _finish(svc, args.verify)
+
+
+def _status(argv) -> int:
+    p = argparse.ArgumentParser(
+        prog="timewarp-tpu sweep status",
+        description="One JSON progress line from a sweep journal.")
+    p.add_argument("--journal", required=True)
+    args = p.parse_args(argv)
+    j = SweepJournal(args.journal)
+    import os
+    if not os.path.exists(j.pack_path):
+        raise SystemExit(
+            f"{args.journal!r} holds no sweep (no pack.json)")
+    pack = SweepPack.load(j.pack_path)
+    scan = j.scan()
+    total = len(pack.configs)
+    done, failed = len(scan.done), len(scan.failed)
+    print(json.dumps({
+        "worlds": total, "completed": done, "failed": sorted(scan.failed),
+        "pending": total - done - failed, "retries": scan.retries,
+        "splits": {k: v for k, v in scan.splits.items()},
+        "buckets_done": sorted(scan.bucket_done),
+        "pack_sha": scan.pack_sha}))
+    return 0
+
+
+def sweep_main(argv) -> int:
+    if not argv or argv[0] not in ("run", "resume", "status"):
+        raise SystemExit(
+            "usage: timewarp-tpu sweep run PACK --journal DIR | "
+            "sweep resume --journal DIR | sweep status --journal DIR")
+    cmd, rest = argv[0], argv[1:]
+    if cmd == "run":
+        return _run(rest)
+    if cmd == "resume":
+        return _resume(rest)
+    return _status(rest)

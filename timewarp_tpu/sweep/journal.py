@@ -1,0 +1,165 @@
+"""Crash-safe sweep journal: append-only JSONL + atomic checkpoints.
+
+One directory per sweep:
+
+- ``pack.json`` — the pack, written atomically at first run; resume
+  reloads it (and refuses a different pack by sha).
+- ``journal.jsonl`` — append-only event log, fsync'd per append.
+  Events: ``pack`` (sha, world count), ``bucket_start``, ``retry``,
+  ``bucket_split``, ``world_done`` (the streamed per-world result),
+  ``world_failed`` (terminal, loud), ``bucket_done``, ``sweep_done``.
+- ``bucket-<id>.npz`` — per-bucket state snapshot via
+  ``utils/checkpoint.save_state`` (atomic: temp + fsync + rename),
+  whose meta carries the per-world digest chain, so a resumed bucket
+  continues the digest exactly where the state is.
+
+Crash model: every append is flushed and fsync'd before the action it
+records is considered durable; a crash can tear at most the *last*
+line, which :meth:`SweepJournal.scan` detects and drops with a
+warning (the event it described simply re-happens on resume — the
+done-set makes re-happening idempotent). A ``world_done`` seen twice
+with *different* results is the one unforgivable state — it means two
+result streams claimed the same world — and scan fails loudly rather
+than pick one.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Set
+
+__all__ = ["SweepJournal", "JournalState", "SweepJournalError"]
+
+_log = logging.getLogger("timewarp.sweep")
+
+
+class SweepJournalError(RuntimeError):
+    """The journal contradicts itself (double-journaled world, mixed
+    packs, mid-file corruption) — never silently reconciled."""
+
+
+@dataclass
+class JournalState:
+    """What a scan of the journal knows."""
+    pack_sha: Optional[str] = None
+    done: Dict[str, dict] = field(default_factory=dict)      # run_id -> result
+    failed: Dict[str, dict] = field(default_factory=dict)    # run_id -> info
+    bucket_done: Set[str] = field(default_factory=set)
+    #: bucket_id -> [child_id, ...] in split order
+    splits: Dict[str, List[str]] = field(default_factory=dict)
+    retries: int = 0
+    events: List[dict] = field(default_factory=list)
+
+
+class SweepJournal:
+    def __init__(self, root: str) -> None:
+        self.root = root
+        self.path = os.path.join(root, "journal.jsonl")
+        self.pack_path = os.path.join(root, "pack.json")
+        self._fh = None
+
+    # -- writing -----------------------------------------------------------
+
+    def ensure_dir(self) -> None:
+        os.makedirs(self.root, exist_ok=True)
+
+    def write_pack(self, pack) -> None:
+        """Atomically persist the pack (resume's source of truth)."""
+        from ..utils.checkpoint import atomic_write
+        self.ensure_dir()
+
+        def write(f):
+            json.dump(pack.to_json(), f, indent=1, sort_keys=True)
+            f.write("\n")
+        atomic_write(self.pack_path, write, mode="w")
+
+    def append(self, rec: Dict[str, Any]) -> None:
+        """Durable append: the record is on disk (flushed + fsync'd)
+        before this returns — the crash-safety contract every caller
+        leans on."""
+        if self._fh is None:
+            self.ensure_dir()
+            self._fh = open(self.path, "a")
+        self._fh.write(json.dumps(rec, sort_keys=True) + "\n")
+        self._fh.flush()
+        os.fsync(self._fh.fileno())
+
+    def close(self) -> None:
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+
+    def checkpoint_path(self, bucket_id: str) -> str:
+        return os.path.join(self.root, f"bucket-{bucket_id}.npz")
+
+    # -- reading -----------------------------------------------------------
+
+    def exists(self) -> bool:
+        return os.path.exists(self.path)
+
+    def records(self) -> List[dict]:
+        """Parse the log. A torn *final* line (crash mid-append) is
+        dropped with a warning; an unparsable line anywhere else is
+        corruption and fails loudly."""
+        if not self.exists():
+            return []
+        with open(self.path) as f:
+            lines = f.read().splitlines()
+        out: List[dict] = []
+        for i, line in enumerate(lines):
+            if not line.strip():
+                continue
+            try:
+                out.append(json.loads(line))
+            except json.JSONDecodeError as e:
+                if i == len(lines) - 1:
+                    _log.warning(
+                        "sweep journal %s: dropping torn final line "
+                        "(crash mid-append): %r", self.path, line[:80])
+                    continue
+                raise SweepJournalError(
+                    f"sweep journal {self.path!r} line {i + 1} is "
+                    f"corrupt mid-file ({e}); a crash can only tear "
+                    "the last line — this journal has been damaged "
+                    "externally") from None
+        return out
+
+    def scan(self) -> JournalState:
+        st = JournalState()
+        for rec in self.records():
+            st.events.append(rec)
+            ev = rec.get("ev")
+            if ev == "pack":
+                if st.pack_sha is not None and st.pack_sha != rec["sha"]:
+                    raise SweepJournalError(
+                        f"journal {self.path!r} holds events for two "
+                        "different packs — one journal dir per sweep")
+                st.pack_sha = rec["sha"]
+            elif ev == "world_done":
+                rid = rec["result"]["run_id"]
+                if rid in st.done:
+                    if st.done[rid] == rec["result"]:
+                        # an interrupted attempt's straggler replayed
+                        # an identical record — harmless, noted
+                        _log.warning("sweep journal: duplicate "
+                                     "world_done for %r (identical "
+                                     "result)", rid)
+                        continue
+                    raise SweepJournalError(
+                        f"world {rid!r} is double-journaled with "
+                        f"DIFFERENT results — refusing to pick one:\n"
+                        f"  first:  {st.done[rid]}\n"
+                        f"  second: {rec['result']}")
+                st.done[rid] = rec["result"]
+            elif ev == "world_failed":
+                st.failed[rec["run_id"]] = rec
+            elif ev == "bucket_done":
+                st.bucket_done.add(rec["bucket"])
+            elif ev == "bucket_split":
+                st.splits[rec["bucket"]] = list(rec["into"])
+            elif ev == "retry":
+                st.retries += 1
+        return st

@@ -1,0 +1,229 @@
+"""Per-bucket execution: chunked, checkpointed, digest-chained.
+
+A :class:`BucketRunner` owns one bucket's engine and drives it one
+chunk at a time (``engine.run`` with per-world remaining budgets —
+the vector-budget driver; the active/remaining bookkeeping is the
+engine's own ``fleet_progress``, shared with ``run_stream`` so the
+quiesce law cannot drift between drivers). Every chunk:
+
+1. the injection hook fires (the deterministic chaos the CI smoke and
+   tests use to provoke retries / OOM splits / mid-sweep kills);
+2. worlds that have quiesced or exhausted their budget since the last
+   chunk stream their result record to the journal — **as they
+   finish**, not at bucket end;
+3. the chunk runs; each world's digest chain and superstep count
+   advance;
+4. the bucket checkpoint is atomically rewritten, its meta carrying
+   the digest chains — so a killed sweep resumes the digests exactly
+   where the state is.
+
+Methods here are *blocking* (they execute XLA programs); the service
+(service.py) calls them through ``AwaitIO`` on an executor thread so
+its watchdogs stay live.
+
+Zombie safety: a watchdog-abandoned attempt's thread may still be
+inside a chunk when the retry starts. Attempts are therefore
+*epoch-stamped*: the service passes each blocking call the epoch it
+belongs to, the watchdog's :meth:`abandon` invalidates that epoch,
+and every commit (journal append, checkpoint write, in-memory
+state/digest update) happens under a lock only if the call's epoch is
+still current — a stale thread raises :class:`StaleAttempt` and can
+never corrupt the retry's digest chain or double-journal a world.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, List, Optional, Set
+
+import numpy as np
+
+from .bucket import Bucket, build_bucket_engine
+from .journal import SweepJournal
+from .spec import DIGEST_ZERO, chain_digest, world_result
+
+__all__ = ["BucketRunner", "StaleAttempt"]
+
+
+class StaleAttempt(RuntimeError):
+    """A watchdog-abandoned thread outlived its attempt: every write
+    path refuses it (raised on an executor thread whose future the
+    supervisor already dropped — nobody observes it, by design)."""
+
+
+class BucketRunner:
+    def __init__(self, bucket: Bucket, journal: SweepJournal,
+                 done: Dict[str, dict], *, lint: str = "warn",
+                 chunk: int = 64, inject=None) -> None:
+        self.bucket = bucket
+        self.journal = journal
+        #: shared run_id -> result map (journaled results land here
+        #: too, so the service reports without rescanning the log)
+        self.done = done
+        self.lint = lint
+        self.chunk = int(chunk)
+        self.inject = inject
+        self.attempts = 0
+        #: attempt generation (module docstring): bumped by
+        #: begin_attempt and by abandon, so a zombie thread's stamped
+        #: epoch can never match again
+        self.epoch = 0
+        self._lock = threading.Lock()
+        self.engine = None
+        self.state = None
+        self.digests: Optional[List[str]] = None
+        self.supersteps: Optional[List[int]] = None
+        self.emitted: Optional[Set[str]] = None
+
+    # -- attempt lifecycle (called from the event-loop thread) -----------
+
+    def begin_attempt(self) -> int:
+        """Start a new attempt generation; returns its epoch (stamped
+        onto every blocking call of this attempt)."""
+        with self._lock:
+            self.epoch += 1
+            return self.epoch
+
+    def abandon(self, epoch: int) -> None:
+        """Watchdog: invalidate ``epoch`` if it is still current —
+        the abandoned thread's writes all fail their epoch check."""
+        with self._lock:
+            if self.epoch == epoch:
+                self.epoch += 1
+
+    def _check(self, epoch: Optional[int]) -> None:
+        if epoch is not None and epoch != self.epoch:
+            raise StaleAttempt(
+                f"bucket {self.bucket.bucket_id!r}: attempt epoch "
+                f"{epoch} was abandoned (current {self.epoch})")
+
+    # -- blocking entry points (run on an executor thread) ---------------
+
+    def prepare(self, epoch: Optional[int] = None) -> None:
+        """Build the engine (once) and (re)load the bucket state from
+        its checkpoint — every retry restarts exactly here, so a
+        transient crash costs at most one chunk of progress."""
+        self._check(epoch)
+        engine = self.engine
+        if engine is None:
+            engine = build_bucket_engine(self.bucket, lint=self.lint)
+        path = self.journal.checkpoint_path(self.bucket.bucket_id)
+        B = self.bucket.B
+        if os.path.exists(path):
+            from ..utils.checkpoint import load_state
+            st, meta = load_state(
+                path, engine.init_state(),
+                expect_meta={"bucket": self.bucket.bucket_id,
+                             "run_ids": list(self.bucket.run_ids)})
+            digests = list(meta["digests"])
+            supersteps = [int(s) for s in meta["supersteps"]]
+        else:
+            st = engine.init_state()
+            digests = [DIGEST_ZERO] * B
+            supersteps = [0] * B
+        with self._lock:
+            self._check(epoch)
+            if self.engine is None:
+                self.engine = engine
+            self.state = st
+            self.digests = digests
+            self.supersteps = supersteps
+            self.emitted = set(self.done)
+
+    def fault_pad(self):
+        """The engine's realized fault-table pad shape — what split
+        children must pad to so the sliced ``restart_done`` state
+        keeps its shape (bucket.py)."""
+        from ..faults.schedule import FaultFleet
+        if self.engine is None or not isinstance(self.engine.faults,
+                                                 FaultFleet):
+            return None
+        return self.engine.faults._pad_shape()
+
+    def step(self, epoch: Optional[int] = None) -> str:
+        """One chunk (module docstring). Returns ``"running"`` or
+        ``"done"`` (every world's result is journaled)."""
+        self._check(epoch)
+        if self.inject is not None:
+            self.inject()
+        eng = self.engine
+        # snapshot the attempt's view; commits re-check the epoch
+        st, digests = self.state, list(self.digests)
+        supersteps = list(self.supersteps)
+        B = self.bucket.B
+        _, remaining, active = eng.fleet_progress(st,
+                                                  self.bucket.budgets)
+        for b in np.nonzero(~active)[0]:
+            cfg = self.bucket.configs[int(b)]
+            if cfg.run_id in self.emitted:
+                continue
+            res = world_result(cfg, st, int(b), digests[int(b)],
+                               supersteps[int(b)])
+            with self._lock:
+                self._check(epoch)
+                self.journal.append({"ev": "world_done",
+                                     "bucket": self.bucket.bucket_id,
+                                     "result": res})
+                self.done[cfg.run_id] = res
+                self.emitted.add(cfg.run_id)
+        if not active.any():
+            return "done"
+        vec = np.where(active, np.minimum(remaining, self.chunk), 0)
+        new_state, traces = eng.run(vec, state=st)
+        for b in range(B):
+            digests[b] = chain_digest(digests[b], traces[b])
+            supersteps[b] += len(traces[b])
+        with self._lock:
+            self._check(epoch)
+            self.state = new_state
+            self.digests = digests
+            self.supersteps = supersteps
+            from ..utils.checkpoint import save_state
+            save_state(
+                self.journal.checkpoint_path(self.bucket.bucket_id),
+                new_state,
+                meta={"bucket": self.bucket.bucket_id,
+                      "run_ids": list(self.bucket.run_ids),
+                      "digests": list(digests),
+                      "supersteps": [int(s) for s in supersteps]})
+        return "running"
+
+    def split_children(self) -> List["BucketRunner"]:
+        """The OOM degradation path: halve the bucket, slice the last
+        good checkpointed state per child (world slices are exact —
+        the batch exactness law), persist each child's checkpoint, and
+        hand back child runners. The caller journals the split event
+        AFTER this returns, so a crash mid-split leaves the parent
+        authoritative."""
+        import dataclasses
+
+        import jax
+
+        pad = self.fault_pad()
+        kids = self.bucket.split()
+        if pad is not None:
+            kids = tuple(dataclasses.replace(k, fault_pad=pad)
+                         for k in kids)
+        mid = kids[0].B
+        parts = [(kids[0], list(range(mid))),
+                 (kids[1], list(range(mid, self.bucket.B)))]
+        runners = []
+        for child, idxs in parts:
+            r = BucketRunner(child, self.journal, self.done,
+                             lint=self.lint, chunk=self.chunk,
+                             inject=self.inject)
+            if self.state is not None:
+                idx = np.asarray(idxs)
+                child_state = jax.tree.map(lambda x: x[idx], self.state)
+                from ..utils.checkpoint import save_state
+                save_state(
+                    self.journal.checkpoint_path(child.bucket_id),
+                    child_state,
+                    meta={"bucket": child.bucket_id,
+                          "run_ids": list(child.run_ids),
+                          "digests": [self.digests[i] for i in idxs],
+                          "supersteps": [self.supersteps[i]
+                                         for i in idxs]})
+            runners.append(r)
+        return runners

@@ -1,0 +1,444 @@
+"""The fault-tolerant sweep service: supervision, retry, split, resume.
+
+The scheduler the ROADMAP's "emulation-as-a-service" item asks for:
+accept a heterogeneous pack, shape-bucket it (bucket.py), and execute
+buckets under a supervision loop built on the manage/ layer's
+:class:`~timewarp_tpu.manage.jobs.JobCurator` running on the real
+asyncio interpreter (interp/aio/timed.py) — each bucket attempt is a
+curator thread job whose blocking chunk calls are offloaded through
+``AwaitIO`` to an executor thread, so the supervisor (and its
+watchdogs) stay live while XLA runs.
+
+Failure policy, per bucket attempt:
+
+- **watchdog timeout** (``bucket_timeout_us``): a per-attempt
+  watchdog interrupts the attempt's child curator with
+  ``WithTimeout(grace_us)`` — Plain-kill now, Force-clear any
+  straggler at the grace deadline — and the attempt counts as a
+  transient failure. The abandoned executor thread's attempt *epoch*
+  is invalidated (runner.py), so it can never again commit state,
+  journal a world, or overwrite a checkpoint — even if it races the
+  retry. (A chunk wedged in a native call that never returns cannot
+  be killed from Python at all: the service itself still terminates
+  — chunks run on a dedicated executor shut down without joining —
+  but process exit then waits on the wedged thread. That residue is
+  a CPython limit, not a supervision gap.)
+- **transient errors** retry with exponential backoff
+  (``backoff_us * 2^(attempt-1)``) from the bucket's last checkpoint,
+  at most ``max_retries`` times; exhaustion is a **loud terminal
+  failure** — every unfinished world journals ``world_failed``, lands
+  in the report's ``failed`` map, and the CLI exits nonzero. Other
+  buckets still complete.
+- **device OOM** (RESOURCE_EXHAUSTED / out-of-memory, or the
+  injected simulation) degrades gracefully: the bucket splits in half
+  from its last checkpoint (exact — world slices, batch exactness
+  law), down to solo buckets; a solo OOM is terminal for that world.
+- :class:`SweepKilled` (the test/CI injection ``die:K``) aborts the
+  whole process mid-sweep — the crash the journal's resume contract
+  is tested against.
+
+Everything observable streams to the journal as it happens
+(journal.py), so ``SweepService.run`` on an existing journal dir IS
+resume: completed worlds are never re-run, in-flight buckets restart
+from their last checkpoint, and the per-world digest chains continue
+to the same value an uninterrupted run produces (the sweep survival
+law, docs/sweeps.md).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import time as _time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from ..core.effects import AwaitIO, Fork, Program, Wait
+from ..core.errors import ThreadKilled
+from ..manage.jobs import JobCurator, Plain, WithTimeout
+from ..manage.sync import Flag
+from .bucket import Bucket, plan_buckets
+from .journal import SweepJournal, SweepJournalError
+from .runner import BucketRunner
+from .spec import SweepPack
+
+__all__ = ["SweepService", "SweepReport", "SweepKilled",
+           "SimulatedTransient", "SimulatedOOM", "InjectPlan"]
+
+_log = logging.getLogger("timewarp.sweep")
+
+
+class SimulatedTransient(RuntimeError):
+    """Injected transient failure (retried like a real one)."""
+
+
+class SimulatedOOM(RuntimeError):
+    """Injected device OOM (split like a real one)."""
+
+
+class SweepKilled(RuntimeError):
+    """Injected hard kill: aborts the sweep process mid-bucket —
+    what `sweep resume` is tested against. Never retried."""
+
+
+def _is_oom(e: BaseException) -> bool:
+    if isinstance(e, SimulatedOOM):
+        return True
+    s = f"{type(e).__name__}: {e}"
+    return "RESOURCE_EXHAUSTED" in s or "out of memory" in s.lower()
+
+
+class InjectPlan:
+    """Deterministic chaos for the service itself (the emulator's
+    chaos is faults/; this injects failures into the *sweep
+    machinery*). Grammar: ``fail:K | oom:K | die:K | hang:K:MS``,
+    ';'-joined — trigger at the K-th chunk-executor call (1-based,
+    counted across the whole sweep), once each."""
+
+    GRAMMAR = ("fail:K | oom:K | die:K | hang:K:MS  "
+               "(';'-joined; K = 1-based chunk call, fires once)")
+
+    def __init__(self, spec: str) -> None:
+        self.fail, self.oom, self.die = set(), set(), set()
+        self.hang: Dict[int, int] = {}
+        self.calls = 0
+        self.fired: List[str] = []
+        for part in spec.split(";"):
+            part = part.strip()
+            if not part:
+                continue
+            bits = part.split(":")
+            try:
+                kind, k = bits[0], int(bits[1])
+                if kind == "fail" and len(bits) == 2:
+                    self.fail.add(k)
+                elif kind == "oom" and len(bits) == 2:
+                    self.oom.add(k)
+                elif kind == "die" and len(bits) == 2:
+                    self.die.add(k)
+                elif kind == "hang" and len(bits) == 3:
+                    self.hang[k] = int(bits[2])
+                else:
+                    raise ValueError(part)
+            except (IndexError, ValueError):
+                # a library-raised, catchable error (the CLI converts
+                # it to a grammar-named exit; an embedding caller —
+                # bench, notebook — must not have its process killed)
+                from .spec import SweepConfigError
+                raise SweepConfigError(
+                    f"malformed inject spec {part!r}; grammar: "
+                    f"{self.GRAMMAR}") from None
+
+    def __call__(self) -> None:
+        self.calls += 1
+        n = self.calls
+        if n in self.hang:
+            self.fired.append(f"hang:{n}")
+            _time.sleep(self.hang[n] / 1000.0)
+            raise SimulatedTransient(
+                f"injected hang ({self.hang[n]} ms) at chunk call {n}")
+        if n in self.fail:
+            self.fired.append(f"fail:{n}")
+            raise SimulatedTransient(f"injected transient failure at "
+                                     f"chunk call {n}")
+        if n in self.oom:
+            self.fired.append(f"oom:{n}")
+            raise SimulatedOOM(f"injected RESOURCE_EXHAUSTED at chunk "
+                               f"call {n}")
+        if n in self.die:
+            self.fired.append(f"die:{n}")
+            raise SweepKilled(f"injected sweep kill at chunk call {n}")
+
+
+@dataclass
+class SweepReport:
+    total: int
+    done: Dict[str, dict]
+    failed: Dict[str, dict]
+    retries: int = 0
+    splits: int = 0
+    buckets: int = 0
+
+    @property
+    def ok(self) -> bool:
+        return not self.failed and len(self.done) == self.total
+
+    def to_json(self) -> dict:
+        return {"worlds": self.total, "completed": len(self.done),
+                "failed": sorted(self.failed), "retries": self.retries,
+                "splits": self.splits, "buckets": self.buckets,
+                "ok": self.ok}
+
+
+@dataclass
+class _Attempt:
+    """Outcome box one bucket attempt fills in."""
+    ok: bool = False
+    error: Optional[BaseException] = None
+    timed_out: bool = False
+    box: dict = field(default_factory=dict)
+
+
+class SweepService:
+    def __init__(self, pack: SweepPack, journal_dir: str, *,
+                 chunk: int = 64, max_retries: int = 2,
+                 backoff_us: int = 50_000,
+                 bucket_timeout_us: Optional[int] = None,
+                 grace_us: int = 500_000, max_bucket: int = 64,
+                 lint: str = "warn", inject=None) -> None:
+        if chunk < 1:
+            raise ValueError(f"chunk must be >= 1, got {chunk}")
+        if max_retries < 0:
+            raise ValueError("max_retries must be >= 0")
+        self.pack = pack
+        self.journal = SweepJournal(journal_dir)
+        self.chunk = chunk
+        self.max_retries = max_retries
+        self.backoff_us = int(backoff_us)
+        self.bucket_timeout_us = bucket_timeout_us
+        self.grace_us = int(grace_us)
+        self.max_bucket = max_bucket
+        self.lint = lint
+        self.inject = (InjectPlan(inject) if isinstance(inject, str)
+                       else inject)
+        self.done: Dict[str, dict] = {}
+        self.failed: Dict[str, dict] = {}
+        self._retries = 0
+        self._splits = 0
+        self._executor = None
+
+    @classmethod
+    def resume(cls, journal_dir: str, **kw) -> "SweepService":
+        """Open an existing journal dir; the pack comes from the
+        journaled copy."""
+        j = SweepJournal(journal_dir)
+        import os
+        if not os.path.exists(j.pack_path):
+            raise SweepJournalError(
+                f"{journal_dir!r} holds no pack.json — nothing to "
+                "resume (run `sweep run PACK --journal DIR` first)")
+        return cls(SweepPack.load(j.pack_path), journal_dir, **kw)
+
+    # -- planning ----------------------------------------------------------
+
+    def _build_queue(self) -> deque:
+        scan = self.journal.scan()
+        if scan.pack_sha is not None and scan.pack_sha != self.pack.sha():
+            raise SweepJournalError(
+                f"journal {self.journal.path!r} was written for a "
+                "different pack (sha mismatch) — one journal dir per "
+                "pack; use a fresh --journal or the journaled pack")
+        self.journal.write_pack(self.pack)
+        if scan.pack_sha is None:
+            self.journal.append({"ev": "pack", "sha": self.pack.sha(),
+                                 "worlds": len(self.pack.configs)})
+        self.done = dict(scan.done)
+        self.failed = dict(scan.failed)
+        self._retries = scan.retries
+
+        def expand(bucket: Bucket) -> List[Bucket]:
+            if bucket.bucket_id not in scan.splits:
+                return [bucket]
+            rec = next(e for e in scan.events
+                       if e.get("ev") == "bucket_split"
+                       and e["bucket"] == bucket.bucket_id)
+            pad = rec.get("fault_pad")
+            kids = bucket.split()
+            if pad is not None:
+                kids = tuple(dataclasses.replace(k, fault_pad=tuple(pad))
+                             for k in kids)
+            self._splits += 1
+            return [g for k in kids for g in expand(k)]
+
+        queue: deque = deque()
+        settled = set(self.done) | set(self.failed)
+        for base in plan_buckets(self.pack.configs, self.max_bucket):
+            for bucket in expand(base):
+                if bucket.bucket_id in scan.bucket_done:
+                    continue
+                if all(r in settled for r in bucket.run_ids):
+                    continue
+                queue.append(BucketRunner(
+                    bucket, self.journal, self.done, lint=self.lint,
+                    chunk=self.chunk, inject=self.inject))
+        self._planned = len(queue)
+        return queue
+
+    # -- the supervision loop (runs under the asyncio interpreter) -------
+
+    def _io(self, fn) -> Program:
+        """Offload a blocking call to the sweep's own executor,
+        awaited through AwaitIO so watchdogs stay live (and a
+        ThreadKilled from one lands here, abandoning — not blocking
+        on — the thread). A dedicated executor, NOT the loop default:
+        asyncio.run joins the default executor at teardown, which
+        would block the service's exit on a wedged abandoned chunk."""
+        import asyncio
+        if self._executor is None:
+            from concurrent.futures import ThreadPoolExecutor
+            self._executor = ThreadPoolExecutor(
+                max_workers=2, thread_name_prefix="tw-sweep")
+        loop = asyncio.get_running_loop()
+        return (yield AwaitIO(loop.run_in_executor(self._executor, fn)))
+
+    def _bucket_body(self, runner: BucketRunner, epoch: int) -> Program:
+        from functools import partial
+        yield from self._io(partial(runner.prepare, epoch))
+        while True:
+            status = yield from self._io(partial(runner.step, epoch))
+            if status == "done":
+                return
+
+    def _attempt(self, jc: JobCurator, runner: BucketRunner) -> Program:
+        """One supervised attempt: the bucket body as a thread job in
+        a per-attempt child curator (nested under the service curator,
+        so the end-of-sweep stop reaches every straggler), with an
+        optional watchdog that escalates through ``WithTimeout`` at
+        the deadline."""
+        out = _Attempt()
+        flag = Flag()
+        child = JobCurator()
+        yield from jc.add_manager_as_job(child, Plain)
+        epoch = runner.begin_attempt()
+        runner.attempts += 1
+
+        def body() -> Program:
+            try:
+                yield from self._bucket_body(runner, epoch)
+                out.ok = True
+            except ThreadKilled:
+                raise
+            except Exception as e:  # noqa: BLE001 — classified below
+                out.error = e
+            finally:
+                yield from flag.set()
+
+        yield from child.add_thread_job(body)
+
+        if self.bucket_timeout_us is not None:
+            deadline = int(self.bucket_timeout_us)
+
+            def watchdog() -> Program:
+                yield Wait(deadline)
+                if not flag.is_set:
+                    out.timed_out = True
+                    # invalidate the attempt's epoch FIRST: the
+                    # zombie thread loses every write path before we
+                    # even deliver the kill (runner.py)
+                    runner.abandon(epoch)
+                    # Plain-kill the attempt now; Force-clear any
+                    # straggler at the grace deadline (the
+                    # manage/jobs.py WithTimeout watchdog)
+                    yield from child.stop_all_jobs(
+                        WithTimeout(self.grace_us, None))
+
+            yield Fork(watchdog)
+
+        yield from flag.wait()
+        if not child.is_closed:
+            # close the (now job-free) curator so nothing dangles
+            yield from child.interrupt_all_jobs(Plain)
+        return out
+
+    def _terminal_failure(self, runner: BucketRunner, reason: str) -> None:
+        """Loud terminal failure: journal + report + ERROR log for
+        every world the bucket never finished. Never silent, never
+        blocking the rest of the sweep."""
+        for cfg in runner.bucket.configs:
+            if cfg.run_id in self.done or cfg.run_id in self.failed:
+                continue
+            rec = {"ev": "world_failed", "run_id": cfg.run_id,
+                   "bucket": runner.bucket.bucket_id,
+                   "attempts": runner.attempts, "error": reason}
+            self.journal.append(rec)
+            self.failed[cfg.run_id] = rec
+            _log.error("sweep: world %r TERMINALLY FAILED after %d "
+                       "attempt(s): %s", cfg.run_id, runner.attempts,
+                       reason)
+
+    def _supervise(self, queue: deque) -> Program:
+        jc = JobCurator()
+        while queue:
+            runner: BucketRunner = queue.popleft()
+            self.journal.append({"ev": "bucket_start",
+                                 "bucket": runner.bucket.bucket_id,
+                                 "attempt": runner.attempts + 1})
+            out = yield from self._attempt(jc, runner)
+            if out.ok:
+                self.journal.append({"ev": "bucket_done",
+                                     "bucket": runner.bucket.bucket_id})
+                continue
+            err = out.error
+            if isinstance(err, SweepKilled):
+                raise err  # the injected hard kill: abort the process
+            if err is not None and _is_oom(err):
+                if runner.bucket.B > 1:
+                    kids = yield from self._io(runner.split_children)
+                    self.journal.append({
+                        "ev": "bucket_split",
+                        "bucket": runner.bucket.bucket_id,
+                        "into": [k.bucket.bucket_id for k in kids],
+                        "fault_pad": runner.fault_pad(),
+                        "reason": str(err)})
+                    self._splits += 1
+                    _log.warning("sweep: bucket %s OOM (%s) — split "
+                                 "into %s", runner.bucket.bucket_id, err,
+                                 [k.bucket.bucket_id for k in kids])
+                    queue.extendleft(reversed(kids))
+                else:
+                    self._terminal_failure(runner, f"device OOM on a "
+                                           f"solo bucket: {err}")
+                continue
+            reason = ("bucket watchdog timeout "
+                      f"({self.bucket_timeout_us} µs)" if out.timed_out
+                      else f"{type(err).__name__}: {err}" if err
+                      else "attempt ended without result")
+            if runner.attempts <= self.max_retries:
+                backoff = self.backoff_us * (
+                    2 ** (runner.attempts - 1))
+                self.journal.append({
+                    "ev": "retry", "bucket": runner.bucket.bucket_id,
+                    "attempt": runner.attempts, "backoff_us": backoff,
+                    "reason": reason})
+                self._retries += 1
+                _log.warning("sweep: bucket %s attempt %d failed (%s) "
+                             "— retrying after %d µs",
+                             runner.bucket.bucket_id, runner.attempts,
+                             reason, backoff)
+                yield Wait(int(backoff))
+                queue.appendleft(runner)
+            else:
+                self._terminal_failure(
+                    runner, f"{reason} (retries exhausted)")
+        # end of sweep: Force-clear anything still straggling at the
+        # grace deadline (a wedged executor thread's job) — the
+        # service must terminate even when a chunk never returns
+        yield from jc.stop_all_jobs(WithTimeout(self.grace_us, None))
+
+    # -- entry point -------------------------------------------------------
+
+    def run(self) -> SweepReport:
+        """Run (or resume — same call) the sweep to completion.
+        Raises :class:`SweepKilled` if an injected kill fires;
+        otherwise always returns a report (terminal failures are in
+        ``report.failed``, never raised)."""
+        from ..interp.aio.timed import run_real_time
+        queue = self._build_queue()
+        try:
+            if queue:
+                run_real_time(lambda: self._supervise(queue))
+            report = SweepReport(
+                total=len(self.pack.configs), done=self.done,
+                failed=self.failed, retries=self._retries,
+                splits=self._splits, buckets=self._planned)
+            self.journal.append({"ev": "sweep_done",
+                                 **report.to_json()})
+            return report
+        finally:
+            self.journal.close()
+            if self._executor is not None:
+                # never join: an abandoned wedged chunk must not keep
+                # a finished (or killed) sweep from returning
+                self._executor.shutdown(wait=False)
+                self._executor = None

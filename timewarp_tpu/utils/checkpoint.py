@@ -44,18 +44,55 @@ can refuse a mismatched fleet before the RNG streams diverge.
 from __future__ import annotations
 
 import json
+import os
+import tempfile
+import zipfile
 from typing import Any
 
 import jax
 import numpy as np
 
-__all__ = ["save_state", "load_state"]
+__all__ = ["save_state", "load_state", "atomic_write"]
+
+#: the layout every actionable corrupt-load error names
+_LAYOUT = ("an .npz holding leaf_0..leaf_{n-1} state arrays plus "
+           "__treedef__/__meta__/__n__ headers, written by "
+           "timewarp_tpu.utils.checkpoint.save_state")
+
+
+def atomic_write(path: str, write_fn, mode: str = "wb") -> None:
+    """Crash- and race-safe file replacement: ``write_fn(f)`` writes
+    into a UNIQUE same-directory temp file (not merely per-pid — two
+    threads saving the same path, e.g. a watchdog-abandoned sweep
+    attempt racing its retry, must not truncate each other's bytes),
+    which is fsync'd then ``os.replace``-d over ``path``. A reader or
+    a crash sees the previous file or the new one, never a torn one.
+    The one atomic-write idiom shared by checkpoints and the sweep
+    journal's pack file."""
+    fd, tmp = tempfile.mkstemp(
+        dir=os.path.dirname(os.path.abspath(path)),
+        prefix=os.path.basename(path) + ".tmp.")
+    try:
+        with os.fdopen(fd, mode) as f:
+            write_fn(f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
 
 
 def save_state(path: str, state: Any, *, meta: dict = None) -> None:
     """Write a state pytree to ``path`` (.npz). ``meta`` (JSON-able)
     rides along — scenario name, seed, anything the loader wants to
-    validate against."""
+    validate against.
+
+    The write is **atomic**: the bytes go to a same-directory temp
+    file, are fsync'd, then ``os.replace``-d over ``path`` — a crash
+    (or concurrent reader) sees the previous checkpoint or the new
+    one, never a torn file. This is what makes checkpoints safe to
+    take every chunk in the sweep service's supervision loop (sweep/)."""
     leaves, treedef = jax.tree.flatten(state)
     arrays = {f"leaf_{i}": np.asarray(jax.device_get(x))
               for i, x in enumerate(leaves)}
@@ -64,8 +101,7 @@ def save_state(path: str, state: Any, *, meta: dict = None) -> None:
     arrays["__meta__"] = np.frombuffer(
         json.dumps(meta or {}).encode(), dtype=np.uint8)
     arrays["__n__"] = np.asarray(len(leaves))
-    with open(path, "wb") as f:
-        np.savez(f, **arrays)
+    atomic_write(path, lambda f: np.savez(f, **arrays))
 
 
 def load_state(path: str, like: Any, *, expect_meta: dict = None):
@@ -74,11 +110,30 @@ def load_state(path: str, like: Any, *, expect_meta: dict = None):
     — the loaded leaves are checked against its shapes/dtypes, so a
     checkpoint from a different scenario config fails loudly instead of
     resuming garbage. Returns ``(state, meta)``."""
-    with np.load(path) as z:
-        n = int(z["__n__"])
-        meta = json.loads(bytes(z["__meta__"].tobytes()).decode())
-        saved_treedef = bytes(z["__treedef__"].tobytes()).decode()
-        leaves = [z[f"leaf_{i}"] for i in range(n)]
+    try:
+        with np.load(path) as z:
+            n = int(z["__n__"])
+            meta = json.loads(bytes(z["__meta__"].tobytes()).decode())
+            saved_treedef = bytes(z["__treedef__"].tobytes()).decode()
+            leaves = [z[f"leaf_{i}"] for i in range(n)]
+    except (FileNotFoundError, PermissionError, IsADirectoryError):
+        # access problems are not corruption: relabeling EACCES as
+        # "corrupt, delete it" would be destructive advice for an
+        # intact file — let the real error name the real cause
+        raise
+    except (KeyError, ValueError, OSError, EOFError,
+            zipfile.BadZipFile, json.JSONDecodeError) as e:
+        # a raw unpickling/zip/shape error names neither the file nor
+        # what a checkpoint is supposed to look like — make the
+        # failure actionable (writes have been atomic since this
+        # module grew os.replace, so a torn file means external
+        # truncation/corruption, not a crashed writer); the raw error
+        # stays chained for whoever needs the forensic detail
+        raise ValueError(
+            f"checkpoint {path!r} is truncated or corrupt "
+            f"({type(e).__name__}: {e}); expected layout: {_LAYOUT}. "
+            f"Delete the file and resume from an earlier checkpoint "
+            f"or re-run from the scenario start.") from e
     t_leaves, treedef = jax.tree.flatten(like)
     if len(t_leaves) != n:
         raise ValueError(
