@@ -19,9 +19,11 @@ def test_bench_config_runs(cfg):
          "gossip_100k_b8": 512, "gossip_100k_chaos": 512,
          "gossip_steady_1m": 512,
          "praos_1m": 512, "praos_1m_fused": 2048,
-         "praos_1m_b4": 512}[cfg]
-    # the gossip waves run to quiescence and assert they got there
-    steps = 20_000 if cfg.startswith("gossip_100k") else 48
+         "praos_1m_b4": 512, "sweep_hetero": 256}[cfg]
+    # the gossip waves run to quiescence and assert they got there;
+    # the sweep-service config takes per-world budgets, not a window
+    steps = 20_000 if cfg.startswith("gossip_100k") else \
+        96 if cfg == "sweep_hetero" else 48
     metric, rate, extra = bench._run_config(cfg, n, steps)
     assert rate > 0
     assert str(n) in metric
@@ -40,8 +42,16 @@ def test_bench_main_prints_one_json_line(capsys, monkeypatch):
     out = capsys.readouterr().out.strip().splitlines()
     assert len(out) == 1
     row = json.loads(out[0])
-    assert set(row) == {"metric", "value", "unit", "vs_baseline", "calib"}
+    assert set(row) == {"metric", "value", "unit", "vs_baseline",
+                        "schema", "platform", "device_kind",
+                        "jax_version", "calib"}
     assert row["unit"] == "msg/s"
+    # environment provenance (ISSUE 7 satellite): the artifact line
+    # itself says where it ran, so CPU-only rounds are visible
+    assert row["schema"] == bench.BENCH_SCHEMA
+    assert row["platform"] == "cpu"   # conftest pins the platform
+    assert isinstance(row["device_kind"], str) and row["device_kind"]
+    assert isinstance(row["jax_version"], str) and row["jax_version"]
     # the self-calibration fingerprint: frozen kernel, positive timing
     assert row["calib"]["kernel"] == "sort_1m_int32_x64"
     assert row["calib"]["seconds"] > 0

@@ -1,0 +1,128 @@
+"""Mismatch diagnostics (trace/events.py): the parity checker's error
+messages are an API. A digest mismatch at 2^20 nodes is debugged from
+the exception text alone, so `TraceMismatch` must name the first
+diverging superstep row and the offending field(s) with their scalar
+values — and never dump raw arrays (ISSUE 7 satellite; the format is
+pinned here so a refactor cannot silently degrade it to a numpy
+repr)."""
+
+import re
+
+import numpy as np
+import pytest
+
+from timewarp_tpu.trace.events import (SuperstepTrace, TraceMismatch,
+                                       assert_states_equal,
+                                       assert_traces_equal)
+
+
+def _trace(rows):
+    return SuperstepTrace.from_rows(rows)
+
+
+def _rows(k=4):
+    return [(1000 * i, 3 + i, 0xAAAA0000 + i, 2, 0xBBBB0000 + i,
+             5, 0xCCCC0000 + i, 0) for i in range(k)]
+
+
+def test_equal_traces_pass():
+    assert_traces_equal(_trace(_rows()), _trace(_rows()))
+
+
+def test_mismatch_names_superstep_row_and_field():
+    rows = _rows()
+    bad = list(rows)
+    bad[2] = bad[2][:3] + (99,) + bad[2][4:]     # recv_count 2 -> 99
+    with pytest.raises(TraceMismatch) as ei:
+        assert_traces_equal(_trace(rows), _trace(bad))
+    msg = str(ei.value)
+    # the first diverging superstep, by index and time
+    assert "superstep 2" in msg
+    assert "t=2000" in msg
+    # the diverging field with both scalar values
+    assert re.search(r"recv_count: 2 != 99", msg)
+    # fields that agree are not listed
+    assert "fired_count" not in msg
+    # both sides are named
+    assert "oracle != engine" in msg
+
+
+def test_mismatch_reports_first_divergence_only():
+    rows = _rows()
+    bad = list(rows)
+    # corrupt rows 1 AND 3: only the FIRST divergence may be reported
+    bad[1] = bad[1][:1] + (77,) + bad[1][2:]
+    bad[3] = bad[3][:1] + (88,) + bad[3][2:]
+    with pytest.raises(TraceMismatch) as ei:
+        assert_traces_equal(_trace(rows), _trace(bad))
+    msg = str(ei.value)
+    assert "superstep 1" in msg and "superstep 3" not in msg
+    assert "77" in msg and "88" not in msg
+
+
+def test_mismatch_custom_names_ride_the_message():
+    rows = _rows(2)
+    bad = [rows[0], rows[1][:5] + (9,) + rows[1][6:]]
+    with pytest.raises(TraceMismatch) as ei:
+        assert_traces_equal(_trace(rows), _trace(bad),
+                            a_name="solo", b_name="fleet-w3")
+    assert "solo != fleet-w3" in str(ei.value)
+
+
+def test_mismatch_never_dumps_arrays():
+    # a LONG pair of traces diverging early: the message must stay a
+    # one-line scalar diagnosis, not a materialized column dump
+    rows = _rows(512)
+    bad = list(rows)
+    bad[0] = bad[0][:6] + (0xDEAD,) + bad[0][7:]
+    with pytest.raises(TraceMismatch) as ei:
+        assert_traces_equal(_trace(rows), _trace(bad))
+    msg = str(ei.value)
+    assert len(msg) < 300, f"diagnostic bloated to {len(msg)} chars"
+    assert "\n" not in msg
+    assert "array(" not in msg and "[" not in msg
+
+
+def test_length_mismatch_names_both_lengths_and_agreement():
+    rows = _rows(5)
+    with pytest.raises(TraceMismatch) as ei:
+        assert_traces_equal(_trace(rows), _trace(rows[:3]))
+    msg = str(ei.value)
+    assert "trace lengths differ" in msg
+    assert "oracle=5" in msg and "engine=3" in msg
+    # the message says how far the prefixes agree — the resume point
+    # for a bisection
+    assert "first 3 supersteps agree" in msg
+
+
+def test_limit_stops_before_length_check():
+    rows = _rows(5)
+    # identical prefix, different length: under limit= the checker
+    # must not raise (the sweep's chunked compares lean on this)
+    assert_traces_equal(_trace(rows), _trace(rows[:3]), limit=3)
+
+
+class _FakeState(tuple):
+    pass
+
+
+def _mk_state(cnt, overflow):
+    from collections import namedtuple
+    St = namedtuple("St", ["states", "overflow"])
+    return St(states={"cnt": np.asarray(cnt)},
+              overflow=np.asarray(overflow))
+
+
+def test_states_equal_names_field_and_tag_without_dumping():
+    a = _mk_state([1, 2, 3, 4], 0)
+    b = _mk_state([1, 2, 3, 4], 7)
+    with pytest.raises(TraceMismatch) as ei:
+        assert_states_equal(a, b, "world 2")
+    msg = str(ei.value)
+    assert "overflow diverged" in msg and "(world 2)" in msg
+    assert len(msg) < 200 and "array(" not in msg
+
+    c = _mk_state([1, 2, 9, 4], 0)
+    with pytest.raises(TraceMismatch) as ei:
+        assert_states_equal(a, c)
+    assert "state.cnt diverged" in str(ei.value)

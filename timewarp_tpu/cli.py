@@ -15,9 +15,17 @@ trace; ``--save`` / ``--resume`` checkpoint through
 utils/checkpoint.py.
 
 Subcommands: ``timewarp-tpu lint`` (the scenario sanitizer sweep,
-below) and ``timewarp-tpu sweep run|resume|status`` (the
-fault-tolerant sweep service over heterogeneous world packs —
-sweep/cli.py, docs/sweeps.md).
+below), ``timewarp-tpu sweep run|resume|status`` (the fault-tolerant
+sweep service over heterogeneous world packs — sweep/cli.py,
+docs/sweeps.md), and ``timewarp-tpu profile FAMILY`` (run a config
+under full telemetry and emit a ready-to-open Perfetto trace —
+docs/observability.md).
+
+Observability flags on runs (docs/observability.md): ``--telemetry
+off|counters|full`` (bit-exact, zero overhead when off),
+``--metrics-out FILE`` (schema-validated JSONL), ``--trace-out FILE``
+(Perfetto/Chrome trace), ``--jax-profile DIR`` (an XLA profiler
+session around the run).
 """
 
 from __future__ import annotations
@@ -167,6 +175,13 @@ def build_faults(args):
 def build_engine(args, sc, link):
     batch = build_batch(args)
     faults = build_faults(args)
+    telemetry = getattr(args, "telemetry", "off")
+    if telemetry != "off" and args.engine == "oracle":
+        raise SystemExit(
+            "--telemetry threads on-device counter planes through the "
+            "jitted engines; the oracle is host Python — its whole "
+            "execution is already observable (use --record-events, or "
+            "run a jitted engine: the traces are bit-identical)")
     if faults is not None and args.engine not in FAULT_ENGINES:
         raise SystemExit(
             f"--faults runs on {', '.join(FAULT_ENGINES)}; "
@@ -225,14 +240,16 @@ def build_engine(args, sc, link):
         return JaxEngine(sc, link, seed=args.seed, window=args.window,
                          route_cap=args.route_cap,
                          record_events=args.record_events,
-                         lint=args.lint, batch=batch, faults=faults)
+                         lint=args.lint, batch=batch, faults=faults,
+                         telemetry=telemetry)
     if args.engine == "sharded-batched":
         from .interp.jax_engine.sharded import (ShardedBatchedEngine,
                                                 make_mesh)
         return ShardedBatchedEngine(
             sc, link, make_mesh(args.devices, axis="worlds"),
             batch=batch, seed=args.seed, window=args.window,
-            route_cap=args.route_cap, lint=args.lint, faults=faults)
+            route_cap=args.route_cap, lint=args.lint, faults=faults,
+            telemetry=telemetry)
     if args.engine == "fused-sparse":
         from .interp.jax_engine.fused_sparse import FusedSparseEngine
         kw = {} if args.max_batch is None else {
@@ -240,11 +257,13 @@ def build_engine(args, sc, link):
         return FusedSparseEngine(sc, link, seed=args.seed,
                                  window=args.window,
                                  record_events=args.record_events,
-                                 lint=args.lint, **kw)
+                                 lint=args.lint, telemetry=telemetry,
+                                 **kw)
     if args.engine == "edge":
         from .interp.jax_engine.edge_engine import EdgeEngine
         return EdgeEngine(sc, link, seed=args.seed, cap=args.edge_cap,
-                          lint=args.lint, faults=faults)
+                          lint=args.lint, faults=faults,
+                          telemetry=telemetry)
     if args.engine in ("sharded", "sharded-edge", "sharded-fused"):
         from .interp.jax_engine.sharded import (
             ShardedEdgeEngine, ShardedEngine,
@@ -253,15 +272,16 @@ def build_engine(args, sc, link):
         if args.engine == "sharded-edge":
             return ShardedEdgeEngine(sc, link, mesh, seed=args.seed,
                                      cap=args.edge_cap,
-                                     lint=args.lint)
+                                     lint=args.lint,
+                                     telemetry=telemetry)
         if args.engine == "sharded-fused":
             return ShardedFusedSparseEngine(
                 sc, link, mesh, seed=args.seed, window=args.window,
-                lint=args.lint)
+                lint=args.lint, telemetry=telemetry)
         return ShardedEngine(sc, link, mesh, seed=args.seed,
                              window=args.window,
                              route_cap=args.route_cap,
-                             lint=args.lint)
+                             lint=args.lint, telemetry=telemetry)
     raise SystemExit(f"unknown engine {args.engine!r}")
 
 
@@ -406,6 +426,9 @@ def main(argv=None) -> int:
         # the fault-tolerant sweep service (sweep/): run|resume|status
         from .sweep.cli import sweep_main
         return sweep_main(argv[1:])
+    if argv and argv[0] == "profile":
+        # full-telemetry run + Perfetto trace (docs/observability.md)
+        return profile_main(argv[1:])
     p = argparse.ArgumentParser(
         prog="timewarp_tpu",
         description="Run a distributed-system scenario under an "
@@ -493,7 +516,31 @@ def main(argv=None) -> int:
                         "'error' refuses to run a scenario with "
                         "error-severity findings, 'off' skips the "
                         "checks entirely")
+    p.add_argument("--telemetry", default="off",
+                   choices=["off", "counters", "full"],
+                   help="on-device telemetry (obs/, docs/"
+                        "observability.md): per-superstep counter "
+                        "planes through the jitted scan — bit-exact, "
+                        "and 'off' lowers to the exact telemetry-free "
+                        "program ('full' adds mailbox occupancy)")
+    p.add_argument("--metrics-out", default=None,
+                   help="write the telemetry metrics stream to this "
+                        "JSONL file (needs --telemetry; validate with "
+                        "`python -m timewarp_tpu.obs.metrics validate`)")
+    p.add_argument("--trace-out", default=None,
+                   help="write a Perfetto/Chrome trace of the run "
+                        "(superstep counter tracks on virtual time; "
+                        "needs --telemetry) — open at ui.perfetto.dev")
+    p.add_argument("--jax-profile", default=None,
+                   help="wrap the run in a jax.profiler session "
+                        "writing to this log dir (view with xprof/"
+                        "TensorBoard); degrades to a warning when "
+                        "profiling is unavailable")
     args = p.parse_args(argv)
+    if args.telemetry == "off" and (args.metrics_out or args.trace_out):
+        raise SystemExit(
+            "--metrics-out/--trace-out need --telemetry counters|full "
+            "(off-mode engines record nothing, by contract)")
 
     from .utils.logconfig import load_log_config
     load_log_config(args.log_config)
@@ -544,7 +591,9 @@ def main(argv=None) -> int:
                 # different seed would silently diverge from both runs
                 args.seed = ck_meta["seed"]
                 engine = build_engine(args, sc, link)
-        final, trace = engine.run(args.steps, state=state)
+        from .obs.profiler import profile_session
+        with profile_session(args.jax_profile):
+            final, trace = engine.run(args.steps, state=state)
         if args.save:
             from .utils.checkpoint import save_state
             meta = {"scenario": sc.name, "seed": args.seed}
@@ -617,8 +666,84 @@ def main(argv=None) -> int:
                    "supersteps": len(trace),
                    "delivered": trace.total_delivered(),
                    **final_info}
+    if args.telemetry != "off":
+        summary.update(_export_telemetry(args, sc, engine, trace))
     print(json.dumps(summary))
     return 0
+
+
+def _export_telemetry(args, sc, engine, trace) -> dict:
+    """Post-run observability export (docs/observability.md): flush
+    the decoded telemetry + the uniform run stats to the metrics
+    JSONL, build the Perfetto trace, and return the summary-line
+    fields. The run itself is already over — nothing here can touch
+    the emulation."""
+    from .obs import MetricsRegistry, TraceBuilder
+    label = f"{sc.name}/{args.engine}"
+    stats = engine.last_run_stats
+    frames = engine.last_run_telemetry
+    info = {"telemetry": {"mode": args.telemetry,
+                          "supersteps": stats["supersteps"],
+                          "wall_seconds": round(stats["wall_seconds"],
+                                                4),
+                          "compiles": stats["compiles"]}}
+    if args.metrics_out:
+        reg = MetricsRegistry(path=args.metrics_out, run=label)
+        if frames is not None:
+            reg.superstep_chunk(label, frames)
+        reg.run_summary(label, stats)
+        reg.close()
+        info["metrics"] = args.metrics_out
+    if args.trace_out:
+        tb = TraceBuilder(process=label)
+        if isinstance(frames, list):
+            for b, fr in enumerate(frames):
+                tb.add_superstep_track(fr, trace[b], world=b)
+        elif frames is not None:
+            tb.add_superstep_track(frames, trace)
+        tb.compile_marks(label, stats["compiles"])
+        info["trace"] = tb.save(args.trace_out)
+    return info
+
+
+def profile_main(argv) -> int:
+    """``timewarp-tpu profile FAMILY``: run a (small, overridable)
+    config of the family under ``--telemetry full`` and emit a
+    ready-to-open Perfetto trace — the one-command observability
+    entry point (docs/observability.md). Extra flags pass through to
+    the run CLI verbatim, so any run the CLI can express can be
+    profiled."""
+    p = argparse.ArgumentParser(
+        prog="timewarp-tpu profile",
+        description="Run a scenario under full telemetry and write a "
+                    "Perfetto trace (open at ui.perfetto.dev).")
+    p.add_argument("scenario",
+                   choices=["token-ring", "gossip", "praos",
+                            "ping-pong"])
+    p.add_argument("--out", default=None,
+                   help="trace file (default "
+                        "tw_profile_<family>.trace.json)")
+    p.add_argument("--metrics-out", default=None,
+                   help="also write the metrics JSONL here")
+    p.add_argument("--jax-profile", default=None,
+                   help="additionally capture a jax.profiler session "
+                        "into this log dir")
+    args, passthrough = p.parse_known_args(argv)
+    out = args.out or f"tw_profile_{args.scenario}.trace.json"
+    run_argv = [args.scenario, "--telemetry", "full",
+                "--trace-out", out]
+    if args.metrics_out:
+        run_argv += ["--metrics-out", args.metrics_out]
+    if args.jax_profile:
+        run_argv += ["--jax-profile", args.jax_profile]
+    # profiling defaults lean small; any passthrough flag overrides
+    # (argparse: the last occurrence wins)
+    defaults = ["--nodes", "512", "--steps", "256"]
+    rc = main(run_argv + defaults + list(passthrough))
+    if rc == 0:
+        print(json.dumps({"profile": args.scenario, "trace": out,
+                          "open": "https://ui.perfetto.dev"}))
+    return rc
 
 
 if __name__ == "__main__":

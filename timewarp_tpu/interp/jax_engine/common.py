@@ -6,7 +6,8 @@ implementation run single-chip or sharded over a mesh
 
 from __future__ import annotations
 
-from typing import NamedTuple
+import time
+from typing import Any, NamedTuple
 
 import jax
 import jax.numpy as jnp
@@ -16,7 +17,7 @@ from ...ops.numeric import I32MAX, group_rank, thi, tlo, u32sum
 
 __all__ = ["LocalComm", "StepOut", "I32MAX", "group_rank", "u32sum",
            "tlo", "thi", "padded_scan", "scan_pad",
-           "init_states_wake"]
+           "init_states_wake", "RunStatsMixin"]
 
 
 def init_states_wake(scenario):
@@ -87,7 +88,13 @@ def padded_scan(step_all, st, n_pad: int, max_steps):
 
 
 class StepOut(NamedTuple):
-    """Per-superstep trace row (valid=False once the scenario quiesced)."""
+    """Per-superstep trace row (valid=False once the scenario quiesced).
+
+    ``telem`` is the opt-in telemetry counter plane
+    (obs/telemetry.py ``TelemetryRow``) — ``None`` unless the engine
+    was built with ``telemetry != "off"``. None is an empty pytree
+    node, so the default adds zero scan outputs and zero jaxpr
+    equations: the zero-overhead-when-off law holds at the type level."""
     valid: jax.Array
     t: jax.Array
     fired_count: jax.Array
@@ -97,6 +104,7 @@ class StepOut(NamedTuple):
     sent_count: jax.Array
     sent_hash: jax.Array
     overflow: jax.Array
+    telem: Any = None
 
 
 class LocalComm:
@@ -120,6 +128,9 @@ class LocalComm:
     def all_sum(self, x: jax.Array) -> jax.Array:
         return x
 
+    def all_max(self, x: jax.Array) -> jax.Array:
+        return x
+
     def roll(self, x: jax.Array, s: int) -> jax.Array:
         """Global roll by ``s`` along the (last) node axis."""
         return jnp.roll(x, s, axis=-1)
@@ -127,3 +138,48 @@ class LocalComm:
     def local_rows(self, table: np.ndarray) -> jax.Array:
         """This device's column block of a host table [..., N]."""
         return jnp.asarray(table)
+
+
+class RunStatsMixin:
+    """Uniform host-side driver accounting for every engine: after any
+    ``run``/``run_quiet``, ``engine.last_run_stats`` holds::
+
+        {"supersteps": int,    # executed this call (fleet total)
+         "wall_seconds": float,
+         "compiles": int}      # driver executables compiled this call
+
+    Compile counting reads the jitted drivers' ``_cache_size`` (the
+    same probe tests/test_world_batch.py pins the pow2 bucketing
+    with), so a run that silently retraced is visible in its stats.
+    Host-side timing only — nothing here is compiled in, so the
+    telemetry zero-overhead law is untouched and the stats exist in
+    every telemetry mode including "off".
+    """
+
+    #: the jitted driver attributes whose compile caches count
+    _DRIVER_FNS = ("_run_scan", "_run_while")
+
+    last_run_stats = None
+
+    def _driver_compiles(self) -> int:
+        n = 0
+        for name in self._DRIVER_FNS:
+            fn = getattr(type(self), name, None)
+            cs = getattr(fn, "_cache_size", None)
+            if cs is not None:
+                n += cs()
+        return n
+
+    def _stats_begin(self):
+        return time.perf_counter(), self._driver_compiles()
+
+    def _stats_end(self, begin, steps_before, steps_after) -> dict:
+        t0, c0 = begin
+        d = (np.asarray(jax.device_get(steps_after), np.int64)
+             - np.asarray(jax.device_get(steps_before), np.int64))
+        self.last_run_stats = {
+            "supersteps": int(d.sum()),
+            "wall_seconds": time.perf_counter() - t0,
+            "compiles": self._driver_compiles() - c0,
+        }
+        return self.last_run_stats

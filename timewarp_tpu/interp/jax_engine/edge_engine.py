@@ -58,7 +58,7 @@ from ...net.delays import LinkModel
 from ...trace.events import SuperstepTrace
 from ...trace.hashing import FIRED, RECV, SENT, mix32_jnp
 from .common import I32MAX as _I32MAX
-from .common import LocalComm, StepOut as _StepOut
+from .common import LocalComm, RunStatsMixin, StepOut as _StepOut
 from .common import padded_scan, scan_pad
 from .common import thi as _thi, tlo as _tlo, u32sum as _u32sum
 
@@ -189,17 +189,26 @@ class EdgeState(NamedTuple):
     restart_done: jax.Array
 
 
-class EdgeEngine:
+class EdgeEngine(RunStatsMixin):
     """Batched engine for static-topology scenarios. Same driver API as
     :class:`~timewarp_tpu.interp.jax_engine.engine.JaxEngine`: ``run``
     (traced, per-superstep rows) and ``run_quiet`` (while_loop, no
-    trace work compiled in)."""
+    trace work compiled in), including the ``telemetry`` knob and its
+    zero-overhead/bit-exactness contract (obs/; the edge engine has no
+    routing ladder, so the rung field is pinned -1 and ``route_drop``
+    0 — per-edge capacity losses are the ``overflow`` counter)."""
 
     def __init__(self, scenario: Scenario, link: LinkModel, *,
                  seed: int = 0, cap: int = 2,
-                 lint: str = "warn", faults=None) -> None:
+                 lint: str = "warn", faults=None,
+                 telemetry: str = "off") -> None:
         # static scenario sanitizer — same knob contract as JaxEngine
         from ...analysis import check_scenario
+        from ...obs.telemetry import validate_mode
+        self.telemetry = validate_mode(telemetry, type(self).__name__)
+        self.metrics = None
+        self.metrics_label = type(self).__name__
+        self.last_run_telemetry = None
         self.lint = lint
         self.lint_report = check_scenario(scenario, lint,
                                           who=type(self).__name__)
@@ -529,6 +538,10 @@ class EdgeEngine:
             RECV, jnp.broadcast_to(node_ids, (E, C, n)),
             rsrc, _tlo(d_abs), _thi(d_abs), st.q_pay[:, :, 0, :])
         recv_hash = comm.all_sum(_u32sum(jnp.where(deliver, rmix, 0)))
+        telem = None
+        if self.telemetry != "off":
+            telem = self._telemetry_row(wake, q_rel, t, out_valid,
+                                        fault_step)
         yrow = _StepOut(
             valid=live, t=t,
             fired_count=comm.all_sum(jnp.sum(fire, dtype=jnp.int32)),
@@ -537,10 +550,43 @@ class EdgeEngine:
             sent_count=comm.all_sum(sent_count),
             sent_hash=comm.all_sum(sent_hash),
             overflow=overflow_step,
+            telem=telem,
         )
         yrow = jax.tree.map(
             lambda x: jnp.where(live, x, jnp.zeros_like(x)), yrow)
         return final, yrow
+
+    def _telemetry_row(self, wake, q_rel, t, out_valid, fault_step):
+        """The edge engine's telemetry plane (obs/telemetry.py) —
+        derived from the post-step wake, post-insert queues, and the
+        step's outbox/fault values, so digests are bit-identical with
+        telemetry on or off. No routing ladder here: rung is -1 and
+        route_drop 0 by construction (per-edge losses are
+        ``overflow``)."""
+        from ...obs.telemetry import TelemetryRow
+        comm = self.comm
+        qmin = q_rel.min()
+        nxt = comm.all_min(jnp.minimum(
+            wake.min(),
+            jnp.where(qmin < _I32MAX, t + qmin.astype(jnp.int64),
+                      jnp.int64(NEVER))))
+        row = TelemetryRow(
+            active_senders=comm.all_sum(jnp.sum(
+                jnp.any(out_valid, axis=0), dtype=jnp.int32)),
+            rung=jnp.int32(-1),
+            route_drop=jnp.int32(0),
+            fault_dropped=fault_step,
+            qslack_us=jnp.where(nxt >= NEVER, jnp.int64(-1), nxt - t),
+        )
+        if self.telemetry == "full":
+            # queue occupancy: per-node fill over the [E, C] axes
+            fill_node = jnp.sum(q_rel < _I32MAX, axis=(0, 1),
+                                dtype=jnp.int32)                # [N]
+            row = row._replace(
+                mb_fill=comm.all_sum(jnp.sum(fill_node,
+                                             dtype=jnp.int32)),
+                mb_peak=comm.all_max(fill_node.max()))
+        return row
 
     # -- drivers ---------------------------------------------------------
 
@@ -601,10 +647,20 @@ class EdgeEngine:
             state: Optional[EdgeState] = None
             ) -> Tuple[EdgeState, SuperstepTrace]:
         st = state if state is not None else self.init_state()
+        begin = self._stats_begin()
         final, ys = self._run_scan(st, scan_pad(max_steps),
                                    jnp.asarray(max_steps, jnp.int64))
-        self._warn_on_overflow(final)
         ys = jax.device_get(ys)
+        self._stats_end(begin, st.steps, final.steps)
+        self.last_run_telemetry = None
+        if self.telemetry != "off" and ys.telem is not None:
+            from ...obs.telemetry import decode_frames
+            self.last_run_telemetry = decode_frames(
+                ys.telem, np.asarray(ys.valid), np.asarray(ys.t))
+            if self.metrics is not None:
+                self.metrics.superstep_chunk(self.metrics_label,
+                                             self.last_run_telemetry)
+        self._warn_on_overflow(final)
         m = np.asarray(ys.valid)
         rows = list(zip(
             np.asarray(ys.t)[m], np.asarray(ys.fired_count)[m],
@@ -623,7 +679,10 @@ class EdgeEngine:
 
     def run_quiet(self, max_steps: int,
                   state: Optional[EdgeState] = None) -> EdgeState:
-        """Traceless driver: one ``while_loop``, digests and counts not
-        even compiled in."""
+        """Traceless driver: one ``while_loop``, digests, counts, and
+        telemetry planes not even compiled in."""
         st = state if state is not None else self.init_state()
-        return self._run_while(st, max_steps)
+        begin = self._stats_begin()
+        final = self._run_while(st, max_steps)
+        self._stats_end(begin, st.steps, final.steps)
+        return final

@@ -49,7 +49,8 @@ from ...trace.events import SuperstepTrace
 from ...trace.hashing import FIRED, RECV, SENT, mix32_jnp
 from .batched import BatchSpec, rebind_link
 from .common import I32MAX as _I32MAX
-from .common import LocalComm, StepOut as _StepOut, group_rank
+from .common import LocalComm, RunStatsMixin, StepOut as _StepOut
+from .common import group_rank
 from .common import padded_scan, scan_pad as _scan_pad
 from .common import thi as _thi, tlo as _tlo, u32sum as _u32sum
 
@@ -112,7 +113,7 @@ class EngineState(NamedTuple):
     restart_done: jax.Array
 
 
-class JaxEngine:
+class JaxEngine(RunStatsMixin):
     """Single-chip batched engine for arbitrary (dynamic-destination)
     scenarios. ``run(max_steps)`` executes up to ``max_steps``
     supersteps under one ``lax.scan`` and returns the final
@@ -216,12 +217,26 @@ class JaxEngine:
                  record_events: int = 0,
                  lint: str = "warn",
                  batch: Optional[BatchSpec] = None,
-                 faults=None) -> None:
+                 faults=None,
+                 telemetry: str = "off") -> None:
         # static scenario sanitizer (analysis/): "warn" logs findings,
         # "error" refuses to construct on contract violations, "off"
         # skips entirely (bit-for-bit the pre-lint behavior — the
         # checks are abstract and never execute the step)
         from ...analysis import check_scenario
+        # opt-in telemetry (obs/): "off" lowers to the exact
+        # telemetry-free jaxpr; "counters"/"full" thread per-superstep
+        # counter planes through the traced scan, derived only from
+        # values the superstep already computes — digests, traces, and
+        # checkpoints are bit-identical in every mode
+        from ...obs.telemetry import validate_mode
+        self.telemetry = validate_mode(telemetry, type(self).__name__)
+        #: attachable obs.metrics.MetricsRegistry: when set, every
+        #: traced run flushes one aggregated `supersteps` line (per
+        #: world, batched) under `metrics_label`
+        self.metrics = None
+        self.metrics_label = type(self).__name__
+        self.last_run_telemetry = None
         self.lint = lint
         self.lint_report = check_scenario(scenario, lint,
                                           who=type(self).__name__)
@@ -687,8 +702,14 @@ class JaxEngine:
             # rung is result-identical to any fitting rung by
             # construction (only cost differs), so the exactness law
             # is untouched.
+            if self.telemetry != "off":
+                self._t_rung = jnp.int32(rungs[-1])
             return tail(rungs[-1])()
         idx = jnp.sum(n_active > jnp.asarray(rungs, jnp.int32))
+        if self.telemetry != "off":
+            # the rung the switch actually takes — recorded where the
+            # decision is made, so telemetry can never drift from it
+            self._t_rung = jnp.asarray(rungs, jnp.int32)[idx]
         return jax.lax.switch(idx, [tail(A) for A in rungs])
 
     def _superstep(self, st: EngineState, with_trace: bool
@@ -830,6 +851,14 @@ class JaxEngine:
                              jnp.maximum(new_wake, now_vec + 1))  # contract #5
         wake = jnp.where(fire, new_wake, st.wake)
         out_valid = out.valid & fire[None, :]                   # [M, N]
+        if self.telemetry != "off":
+            # telemetry side channel (consumed by _finish_superstep in
+            # this same trace): senders with >= 1 valid outbox message
+            # — the event-density signal; the routing stage below
+            # overrides the rung when it runs a ladder
+            self._t_senders = comm.all_sum(jnp.sum(
+                jnp.any(out_valid, axis=0), dtype=jnp.int32))
+            self._t_rung = jnp.int32(-1)
 
         # 5. drop delivered messages and rebase surviving deliver-times
         #    to the new epoch t. Two regimes:
@@ -1177,6 +1206,11 @@ class JaxEngine:
             st.mb_payload[:, 0, :])
         recv_hash = comm.all_sum(_u32sum(jnp.where(deliver, recv_mix, 0)))
 
+        telem = None
+        if self.telemetry != "off":
+            telem = self._telemetry_row(wake, mb_rel, t,
+                                        route_drop_step,
+                                        fault_dropped_step)
         yrow = _StepOut(
             valid=live, t=t,
             fired_count=comm.all_sum(jnp.sum(fire, dtype=jnp.int32)),
@@ -1184,11 +1218,46 @@ class JaxEngine:
             recv_count=recv_count, recv_hash=recv_hash,
             sent_count=sent_count, sent_hash=sent_hash,
             overflow=overflow_step,
+            telem=telem,
         )
         # mask the trace row too when not live
         yrow = jax.tree.map(
             lambda x: jnp.where(live, x, jnp.zeros_like(x)), yrow)
         return final, yrow
+
+    def _telemetry_row(self, wake, mb_rel, t, route_drop_step,
+                       fault_dropped_step):
+        """The per-superstep telemetry counter plane (obs/telemetry.py)
+        — derived ONLY from values this superstep already computed
+        (post-step wake, post-insertion mailbox, the step's drop
+        deltas, the routing side channels), so it cannot perturb the
+        emulation: digests are bit-identical with telemetry on or off
+        (tests/test_zztelemetry.py)."""
+        from ...obs.telemetry import TelemetryRow
+        comm = self.comm
+        mmin = mb_rel.min()
+        nxt = comm.all_min(jnp.minimum(
+            wake.min(),
+            jnp.where(mmin == _I32MAX, jnp.int64(NEVER),
+                      t + mmin.astype(jnp.int64))))
+        row = TelemetryRow(
+            active_senders=self._t_senders,
+            rung=self._t_rung,
+            route_drop=route_drop_step,
+            fault_dropped=(jnp.int32(0) if fault_dropped_step is None
+                           else fault_dropped_step),
+            qslack_us=jnp.where(nxt >= NEVER, jnp.int64(-1), nxt - t),
+        )
+        if self.telemetry == "full":
+            # the mailbox occupancy plane: one extra [K, N] pass —
+            # "full" mode's documented cost
+            fill_node = jnp.sum(mb_rel < _I32MAX, axis=0,
+                                dtype=jnp.int32)                # [N]
+            row = row._replace(
+                mb_fill=comm.all_sum(jnp.sum(fill_node,
+                                             dtype=jnp.int32)),
+                mb_peak=comm.all_max(fill_node.max()))
+        return row
 
     # -- the world axis (batch=BatchSpec) --------------------------------
 
@@ -1326,8 +1395,11 @@ class JaxEngine:
         buckets — padded_scan in common.py)."""
         st = state if state is not None else self.init_state()
         budget, top = self._coerce_budget(max_steps)
+        begin = self._stats_begin()
         final, ys = self._run_scan(st, _scan_pad(top), budget)
         ys = jax.device_get(ys)
+        self._stats_end(begin, st.steps, final.steps)
+        self._capture_telemetry(ys)
         if self.batch is not None:
             return final, self._decode_traces(ys)
         m = np.asarray(ys.valid)
@@ -1360,11 +1432,31 @@ class JaxEngine:
     def run_quiet(self, max_steps,
                   state: Optional[EngineState] = None) -> EngineState:
         """Traceless driver for benchmarking: one ``while_loop``, no
-        per-step host materialization and no digest work compiled in.
+        per-step host materialization and no digest work compiled in
+        — telemetry planes included (per-superstep rows need the scan
+        driver; ``last_run_stats`` is still populated).
         Accepts per-world budgets like :meth:`run` (batched only)."""
         st = state if state is not None else self.init_state()
         budget, _ = self._coerce_budget(max_steps)
-        return self._run_while(st, budget)
+        begin = self._stats_begin()
+        final = self._run_while(st, budget)
+        self._stats_end(begin, st.steps, final.steps)
+        return final
+
+    def _capture_telemetry(self, ys) -> None:
+        """Host-side decode of one traced run's telemetry rows onto
+        ``last_run_telemetry`` (+ a chunk flush to an attached
+        metrics registry) — a no-op in off mode."""
+        self.last_run_telemetry = None
+        if self.telemetry == "off" or ys is None or ys.telem is None:
+            return
+        from ...obs.telemetry import decode_frames
+        B = None if self.batch is None else self.batch.B
+        self.last_run_telemetry = decode_frames(
+            ys.telem, np.asarray(ys.valid), np.asarray(ys.t), B)
+        if self.metrics is not None:
+            self.metrics.superstep_chunk(self.metrics_label,
+                                         self.last_run_telemetry)
 
     # -- streaming fleet driver (the sweep service's engine surface) -----
 

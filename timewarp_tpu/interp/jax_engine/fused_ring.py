@@ -53,6 +53,7 @@ from jax.experimental.pallas import tpu as pltpu
 from ...core.scenario import NEVER, Scenario
 from ...net.delays import FixedDelay
 from .common import I32MAX as _I32MAX
+from .common import RunStatsMixin
 from .edge_engine import EdgeEngine, EdgeState
 
 __all__ = ["FusedRingEngine", "FusedRingState"]
@@ -283,15 +284,30 @@ def _superstep_kernel(scal, st_ref, out_ref, cnt_ref):
     )
 
 
-class FusedRingEngine:
+class FusedRingEngine(RunStatsMixin):
     """Single-kernel dense-ring executor. Same ``run_quiet`` contract
     as :class:`EdgeEngine`; ``to_edge_state`` converts back for the
-    exact-equality law."""
+    exact-equality law. Carries the uniform ``last_run_stats`` and the
+    ``telemetry`` knob — but per-superstep telemetry planes need a
+    traced scan driver, and this engine runs only the fused
+    while-loop, so any mode but "off" is refused loudly (run the XLA
+    :class:`EdgeEngine` when you need the counters; it is bit-exact
+    to this engine by the fused-ring law)."""
 
     def __init__(self, scenario: Scenario, link, *, cap: int = 2,
-                 lint: str = "warn") -> None:
+                 lint: str = "warn", telemetry: str = "off") -> None:
         # static scenario sanitizer — same knob contract as EdgeEngine
         from ...analysis import check_scenario
+        from ...obs.telemetry import validate_mode
+        self.telemetry = validate_mode(telemetry, type(self).__name__)
+        if self.telemetry != "off":
+            raise ValueError(
+                "FusedRingEngine runs the whole superstep as one fused "
+                "while-loop kernel — there is no traced scan to thread "
+                "per-superstep telemetry planes through; run the XLA "
+                "EdgeEngine (bit-exact to this engine) with "
+                f"telemetry={self.telemetry!r} instead")
+        self.last_run_telemetry = None
         self.lint = lint
         self.lint_report = check_scenario(scenario, lint,
                                           who=type(self).__name__)
@@ -485,4 +501,7 @@ class FusedRingEngine:
 
     def run_quiet(self, max_steps: int, state=None) -> FusedRingState:
         fs = state if state is not None else self.init_state()
-        return self._run_while(fs, max_steps)
+        begin = self._stats_begin()
+        final = self._run_while(fs, max_steps)
+        self._stats_end(begin, fs.steps, final.steps)
+        return final

@@ -524,10 +524,10 @@ class FusedSparseEngine(JaxEngine):
     def __init__(self, scenario: Scenario, link: LinkModel, *,
                  seed: int = 0, window=1, record_events: int = 0,
                  max_batch: int = 1 << 16,
-                 lint: str = "warn") -> None:
+                 lint: str = "warn", telemetry: str = "off") -> None:
         super().__init__(scenario, link, seed=seed, window=window,
                          route_cap=None, record_events=record_events,
-                         lint=lint)
+                         lint=lint, telemetry=telemetry)
         sc = scenario
         if link.can_drop:
             raise ValueError(
@@ -572,6 +572,9 @@ class FusedSparseEngine(JaxEngine):
         n = self.comm.n_local
         n_glob = self.comm.n_global
         W = self.window
+        if self.telemetry != "off":
+            # the fused engine's "rung" is its static VMEM batch slice
+            self._t_rung = jnp.int32(self._A)
 
         dst32 = out.dst.astype(jnp.int32)                       # [M, N]
         dst_okf = (dst32 >= 0) & (dst32 < n_glob)

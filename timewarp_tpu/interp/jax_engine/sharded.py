@@ -47,8 +47,10 @@ class ShardedEdgeEngine(ShardedDriver, EdgeEngine):
 
     def __init__(self, scenario: Scenario, link: LinkModel,
                  mesh: Mesh, *, axis: AxisName = "nodes", seed: int = 0,
-                 cap: int = 2, lint: str = "warn") -> None:
-        super().__init__(scenario, link, seed=seed, cap=cap, lint=lint)
+                 cap: int = 2, lint: str = "warn",
+                 telemetry: str = "off") -> None:
+        super().__init__(scenario, link, seed=seed, cap=cap, lint=lint,
+                         telemetry=telemetry)
         bad = [e for e, s in enumerate(self.topo.shift) if s is None]
         if bad:
             raise ValueError(
@@ -101,9 +103,10 @@ class ShardedEngine(ShardedDriver, JaxEngine):
                  bucket_cap: Optional[int] = None,
                  window: int = 1,
                  route_cap: Optional[int] = None,
-                 lint: str = "warn") -> None:
+                 lint: str = "warn", telemetry: str = "off") -> None:
         super().__init__(scenario, link, seed=seed, window=window,
-                         route_cap=route_cap, lint=lint)
+                         route_cap=route_cap, lint=lint,
+                         telemetry=telemetry)
         self.mesh = mesh
         self.axis = axis
         D = axis_size(mesh, axis)
@@ -200,10 +203,11 @@ class ShardedBatchedEngine(ShardedDriver, JaxEngine):
                  mesh: Mesh, *, batch: BatchSpec,
                  axis: AxisName = "worlds", seed: int = 0,
                  window=1, route_cap: Optional[int] = None,
-                 lint: str = "warn", faults=None) -> None:
+                 lint: str = "warn", faults=None,
+                 telemetry: str = "off") -> None:
         super().__init__(scenario, link, seed=seed, window=window,
                          route_cap=route_cap, lint=lint, batch=batch,
-                         faults=faults)
+                         faults=faults, telemetry=telemetry)
         if batch is None:
             raise ValueError(
                 "ShardedBatchedEngine shards the world axis; it needs "
@@ -273,10 +277,11 @@ class ShardedFusedSparseEngine(ShardedEngine):
     def __init__(self, scenario: Scenario, link: LinkModel,
                  mesh: Mesh, *, axis: AxisName = "nodes", seed: int = 0,
                  bucket_cap: Optional[int] = None,
-                 window: int = 1, lint: str = "warn") -> None:
+                 window: int = 1, lint: str = "warn",
+                 telemetry: str = "off") -> None:
         super().__init__(scenario, link, mesh, axis=axis, seed=seed,
                          bucket_cap=bucket_cap, window=window,
-                         route_cap=None, lint=lint)
+                         route_cap=None, lint=lint, telemetry=telemetry)
         from .fused_sparse import _build_kernel, _insertion_plan
         sc = scenario
         nl = self.comm.n_local

@@ -54,6 +54,9 @@ class SuperstepOracle:
     dynamic violations counted in ``short_delay_total``).
     """
 
+    #: the uniform driver-accounting surface (populated by run())
+    last_run_stats = None
+
     def __init__(self, scenario: Scenario, link: LinkModel, *,
                  seed: int = 0, record_events: bool = False,
                  window=1, lint: str = "warn", faults=None) -> None:
@@ -269,6 +272,8 @@ class SuperstepOracle:
 
     def run(self, max_steps: int = 1 << 30,
             until: Optional[Microsecond] = None) -> SuperstepTrace:
+        import time as _time
+        _wall0 = _time.perf_counter()
         sc = self.scenario
         n, M, K, P = sc.n_nodes, sc.max_out, sc.mailbox_cap, sc.payload_width
         W = self.window
@@ -414,4 +419,12 @@ class SuperstepOracle:
                          recv_count, combine_py(recv_hashes),
                          sent_count, combine_py(sent_hashes),
                          overflow_step))
+        # the uniform driver-accounting surface every engine carries
+        # (interp/jax_engine/common.py RunStatsMixin); the oracle is
+        # host Python, so compiles is 0 by definition
+        self.last_run_stats = {
+            "supersteps": len(rows),
+            "wall_seconds": _time.perf_counter() - _wall0,
+            "compiles": 0,
+        }
         return SuperstepTrace.from_rows(rows)

@@ -1,0 +1,53 @@
+"""Opt-in observability: on-device telemetry, metrics, Perfetto traces.
+
+The emulator at production scale is a black box in flight unless it
+reports on itself — SCALE-Sim TPU (PAPERS.md) reports utilization per
+workload so packing decisions are measurable, and Revati frames the
+emulator as a *serving* system, which demands runtime observability.
+This package is that sensor layer, under one hard contract:
+
+**Zero overhead when off, bit-exact when on.** Every engine takes
+``telemetry="off"|"counters"|"full"``. ``"off"`` (the default) lowers
+to the exact pre-telemetry jaxpr — not "cheap", *absent* — and every
+mode produces bit-identical digests, traces, and checkpoints, because
+telemetry planes are *derived only from values the superstep already
+computes* and ride as extra scan outputs that feed nothing back
+(tests/test_zztelemetry.py pins both halves of the law).
+
+Layers:
+
+- :mod:`~timewarp_tpu.obs.telemetry` — the on-device per-superstep
+  counter row (:class:`TelemetryRow`) and its host-side decode
+  (:class:`TelemetryFrames`): active senders, selected routing rung,
+  mailbox fill high-water, per-world quiescence slack, route/fault
+  drop deltas.
+- :mod:`~timewarp_tpu.obs.metrics` — :class:`MetricsRegistry`, a
+  schema-validated JSONL metrics stream aggregating chunk-flushed
+  telemetry, spans, and run summaries (``python -m
+  timewarp_tpu.obs.metrics validate FILE`` is the CI gate).
+- :mod:`~timewarp_tpu.obs.perfetto` — :class:`TraceBuilder`, a
+  Chrome-trace/Perfetto exporter: wall-clock spans (sweep attempts,
+  retries, checkpoints, journal fsyncs, jit compiles) on one process
+  track, virtual-time superstep counters on another. Open the file at
+  https://ui.perfetto.dev.
+- :mod:`~timewarp_tpu.obs.profiler` — optional ``jax.profiler``
+  session wrapping with named annotations (degrades to a no-op when
+  profiling is unavailable).
+
+docs/observability.md is the user-facing guide.
+"""
+
+from .metrics import (METRICS_SCHEMA, MetricsRegistry, validate_line,
+                      validate_metrics_file)
+from .perfetto import TraceBuilder
+from .profiler import annotate, profile_session
+from .telemetry import (TELEMETRY_MODES, TelemetryFrames, TelemetryRow,
+                        decode_frames, summarize_frames, validate_mode)
+
+__all__ = [
+    "TELEMETRY_MODES", "TelemetryRow", "TelemetryFrames",
+    "decode_frames", "summarize_frames", "validate_mode",
+    "METRICS_SCHEMA", "MetricsRegistry", "validate_line",
+    "validate_metrics_file",
+    "TraceBuilder", "profile_session", "annotate",
+]

@@ -1,0 +1,206 @@
+"""MetricsRegistry: a schema-validated JSONL metrics stream.
+
+One line per observation, every line self-describing::
+
+    {"schema": 1, "kind": "supersteps", "label": "...", ...}
+
+Kinds:
+
+- ``supersteps`` — one chunk of per-superstep telemetry, aggregated
+  (obs/telemetry.py ``summarize_frames``): supersteps covered, virtual
+  time span, load-signal min/mean/max, drop-counter sums, minimum
+  quiescence slack. Batched engines flush one line per world.
+- ``span`` — a wall-clock span (name + ``wall_s``): sweep bucket
+  attempts, retry backoffs, checkpoint writes, journal fsyncs.
+- ``run_summary`` — one line per driver run: the engine's uniform
+  ``last_run_stats`` (supersteps, wall seconds, driver compiles).
+- ``utilization`` — per-bucket sweep utilization (sweep/runner.py):
+  worlds-active occupancy, budget-mask efficiency, pow2 scan-pad
+  waste.
+- ``event`` — a point event (OOM split, terminal failure, …).
+
+The registry validates every line at emit time AND the file is
+re-validatable after the fact — ``python -m timewarp_tpu.obs.metrics
+validate FILE`` is the CI gate (a malformed stream fails loudly,
+never parses "close enough").
+
+A registry with no path accumulates lines in memory only (the CLI's
+summary aggregation); with a path it appends one flushed line per
+emit, so a crashed run keeps every line up to the crash.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from contextlib import contextmanager
+from typing import Any, Dict, List, Optional
+
+__all__ = ["METRICS_SCHEMA", "MetricsRegistry", "validate_line",
+           "validate_metrics_file"]
+
+#: bump when a kind's required fields change shape
+METRICS_SCHEMA = 1
+
+_NUM = (int, float)
+#: kind -> {required field: type tuple}; extra fields are allowed
+#: (forward-compatible), missing/badly-typed required ones are not
+_KINDS: Dict[str, Dict[str, tuple]] = {
+    "supersteps": {"label": (str,), "supersteps": (int,)},
+    "span": {"name": (str,), "wall_s": _NUM},
+    "run_summary": {"label": (str,), "supersteps": (int,),
+                    "wall_seconds": _NUM, "compiles": (int,)},
+    "utilization": {"bucket": (str,), "worlds": (int,),
+                    "chunks": (int,), "world_supersteps": (int,),
+                    "scan_supersteps": (int,),
+                    "budget_efficiency": _NUM,
+                    "pad_waste_frac": _NUM,
+                    "worlds_active_mean": _NUM},
+    "event": {"name": (str,)},
+}
+
+
+def validate_line(rec: Any) -> None:
+    """Validate one metrics record against the schema; raises
+    ``ValueError`` naming the offense (never a KeyError/TypeError)."""
+    if not isinstance(rec, dict):
+        raise ValueError(f"metrics line must be a JSON object, got "
+                         f"{type(rec).__name__}")
+    if rec.get("schema") != METRICS_SCHEMA:
+        raise ValueError(
+            f"metrics line schema {rec.get('schema')!r} != "
+            f"{METRICS_SCHEMA} (this reader)")
+    kind = rec.get("kind")
+    if kind not in _KINDS:
+        raise ValueError(f"unknown metrics kind {kind!r}; known: "
+                         f"{sorted(_KINDS)}")
+    for field, types in _KINDS[kind].items():
+        v = rec.get(field)
+        if isinstance(v, bool) or not isinstance(v, types):
+            raise ValueError(
+                f"metrics kind {kind!r}: field {field!r} must be "
+                f"{'/'.join(t.__name__ for t in types)}, got {v!r}")
+
+
+def validate_metrics_file(path: str) -> int:
+    """Validate every line of a metrics JSONL file; returns the line
+    count, raises ``ValueError`` naming file and line on the first
+    offense — the CI telemetry-smoke gate."""
+    n = 0
+    with open(path) as f:
+        for i, line in enumerate(f, 1):
+            if not line.strip():
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError as e:
+                raise ValueError(
+                    f"{path}:{i}: not JSON ({e})") from None
+            try:
+                validate_line(rec)
+            except ValueError as e:
+                raise ValueError(f"{path}:{i}: {e}") from None
+            n += 1
+    return n
+
+
+class MetricsRegistry:
+    """Aggregating sink for telemetry frames, spans, and summaries
+    (module docstring). ``tracer`` (an obs.perfetto.TraceBuilder)
+    optionally mirrors spans/events onto the Perfetto timeline so one
+    instrumentation call feeds both outputs."""
+
+    def __init__(self, path: Optional[str] = None,
+                 run: Optional[str] = None, tracer=None) -> None:
+        self.path = path
+        self.run = run
+        self.tracer = tracer
+        self.lines: List[dict] = []
+        self._fh = None
+        #: emits may race: the sweep's chunk executor flushes engine
+        #: telemetry while the supervisor thread emits spans — and a
+        #: watchdog-abandoned zombie chunk may still flush after its
+        #: retry started. Metrics are observability (a duplicate
+        #: chunk line is harmless), but a TORN line would fail the
+        #: validate gate, so writes serialize under one lock.
+        self._lock = threading.Lock()
+
+    # -- emission ----------------------------------------------------------
+
+    def emit(self, kind: str, **fields) -> dict:
+        rec = {"schema": METRICS_SCHEMA, "kind": kind}
+        if self.run is not None:
+            rec["run"] = self.run
+        rec.update(fields)
+        validate_line(rec)  # never write a line the gate would reject
+        with self._lock:
+            self.lines.append(rec)
+            if self.path is not None:
+                if self._fh is None:
+                    self._fh = open(self.path, "a")
+                self._fh.write(json.dumps(rec, sort_keys=True) + "\n")
+                self._fh.flush()
+        return rec
+
+    def superstep_chunk(self, label: str, frames,
+                        world: Optional[int] = None) -> None:
+        """Flush one chunk of decoded telemetry (a TelemetryFrames, or
+        the batched engines' per-world list) as ``supersteps`` lines."""
+        from .telemetry import summarize_frames
+        if isinstance(frames, list):
+            for b, fr in enumerate(frames):
+                self.emit("supersteps", label=label, world=b,
+                          **summarize_frames(fr))
+            return
+        extra = {} if world is None else {"world": world}
+        self.emit("supersteps", label=label, **extra,
+                  **summarize_frames(frames))
+
+    def run_summary(self, label: str, stats: dict, **fields) -> None:
+        """One line per driver run from the engine's uniform
+        ``last_run_stats``."""
+        self.emit("run_summary", label=label,
+                  supersteps=int(stats["supersteps"]),
+                  wall_seconds=float(stats["wall_seconds"]),
+                  compiles=int(stats["compiles"]), **fields)
+
+    def event(self, name: str, **fields) -> None:
+        self.emit("event", name=name, **fields)
+        if self.tracer is not None:
+            self.tracer.instant(name, args=fields or None)
+
+    @contextmanager
+    def span(self, name: str, **fields):
+        """Wall-clock span, mirrored onto the Perfetto timeline when a
+        tracer is attached."""
+        t0 = time.perf_counter()
+        ts = None if self.tracer is None else self.tracer.now_us()
+        try:
+            yield
+        finally:
+            dt = time.perf_counter() - t0
+            self.emit("span", name=name, wall_s=round(dt, 6), **fields)
+            if self.tracer is not None:
+                self.tracer.complete(name, dur_us=dt * 1e6, ts_us=ts,
+                                     args=fields or None)
+
+    def close(self) -> None:
+        with self._lock:
+            if self._fh is not None:
+                self._fh.close()
+                self._fh = None
+
+
+def _main(argv) -> int:
+    if len(argv) != 2 or argv[0] != "validate":
+        raise SystemExit(
+            "usage: python -m timewarp_tpu.obs.metrics validate FILE")
+    n = validate_metrics_file(argv[1])
+    print(json.dumps({"file": argv[1], "lines": n, "ok": True}))
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(_main(sys.argv[1:]))

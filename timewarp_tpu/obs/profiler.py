@@ -1,0 +1,58 @@
+"""Optional ``jax.profiler`` integration.
+
+The telemetry counters (obs/telemetry.py) answer *what the emulation
+did*; the XLA profiler answers *where the chip time went*. This module
+wraps the latter so callers can always write ``with
+profile_session(logdir):`` — when profiling is unavailable (no
+tensorboard-plugin-profile, an unsupported backend, a tunnel that
+refuses the trace RPC) the session degrades to a warned no-op instead
+of killing the run. Nothing here ever imports at engine-construction
+time; the zero-overhead law is untouched.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+from contextlib import contextmanager
+from typing import Optional
+
+__all__ = ["profile_session", "annotate"]
+
+_log = logging.getLogger("timewarp.obs")
+
+
+@contextmanager
+def profile_session(logdir: Optional[str]):
+    """A ``jax.profiler`` trace session writing to ``logdir`` (view
+    with TensorBoard or xprof). ``logdir=None`` — and any profiler
+    failure — yields a plain no-op session; the emulation must never
+    die for its own instrumentation."""
+    if not logdir:
+        yield None
+        return
+    try:
+        import jax.profiler as _jp
+        _jp.start_trace(logdir)
+    except Exception as e:  # noqa: BLE001 — degrade, never kill the run
+        _log.warning("jax.profiler session unavailable (%s); running "
+                     "without a device profile", e)
+        yield None
+        return
+    try:
+        yield logdir
+    finally:
+        try:
+            _jp.stop_trace()
+        except Exception as e:  # noqa: BLE001
+            _log.warning("jax.profiler stop_trace failed: %s", e)
+
+
+def annotate(name: str):
+    """A named ``TraceAnnotation`` context (shows up as a labeled span
+    in the device profile), or a null context when unavailable."""
+    try:
+        from jax.profiler import TraceAnnotation
+        return TraceAnnotation(name)
+    except Exception:  # noqa: BLE001
+        return contextlib.nullcontext()

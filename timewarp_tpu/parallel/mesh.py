@@ -125,6 +125,11 @@ class MeshComm(LocalComm):
     def all_sum(self, x: jax.Array) -> jax.Array:
         return jax.lax.psum(x, self.axis)
 
+    def all_max(self, x: jax.Array) -> jax.Array:
+        # same gather-then-reduce shape as all_min (pmax shares pmin's
+        # lowering caveat on the TPU compiler path)
+        return jax.lax.all_gather(x, self.axis).max()
+
     def roll(self, x: jax.Array, s: int) -> jax.Array:
         """Global roll by ``s`` along the last (node) axis: local roll +
         boundary-slice ``ppermute`` to the next shard (and a whole-shard

@@ -104,12 +104,14 @@ def plan_buckets(configs, max_bucket: int = 64) -> List[Bucket]:
     return buckets
 
 
-def build_bucket_engine(bucket: Bucket, *, lint: str = "warn"):
+def build_bucket_engine(bucket: Bucket, *, lint: str = "warn",
+                        telemetry: str = "off"):
     """One batched :class:`~timewarp_tpu.interp.jax_engine.engine.
     JaxEngine` serving every world of the bucket. World b's seed,
     sweepable link values, and (padded) fault schedule are exactly
     the solo run's — the batch exactness law then carries the sweep
-    survival law."""
+    survival law (telemetry included: the counter planes feed nothing
+    back, so the streamed results are mode-independent, obs/)."""
     from ..faults.schedule import FaultFleet, FaultSchedule
     from ..interp.jax_engine.batched import BatchSpec
     from ..interp.jax_engine.engine import JaxEngine
@@ -136,5 +138,7 @@ def build_bucket_engine(bucket: Bucket, *, lint: str = "warn"):
     empty = all(not s.events for s in scheds)
     fleet = None if empty and (pad is None or tuple(pad) == (0, 0, 0)) \
         else FaultFleet(tuple(scheds))
-    return JaxEngine(sc, links[0], window=bucket.window, batch=spec,
-                     faults=fleet, lint=lint)
+    eng = JaxEngine(sc, links[0], window=bucket.window, batch=spec,
+                    faults=fleet, lint=lint, telemetry=telemetry)
+    eng.metrics_label = f"bucket:{bucket.bucket_id}"
+    return eng

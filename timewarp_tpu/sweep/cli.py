@@ -71,14 +71,29 @@ def _service_args(p: argparse.ArgumentParser) -> None:
                    help="after the sweep, re-run every completed "
                         "world solo and assert the streamed result is "
                         "bit-identical (the sweep survival law)")
+    p.add_argument("--telemetry", default="off",
+                   choices=["off", "counters", "full"],
+                   help="engine telemetry mode (obs/, "
+                        "docs/observability.md): bucket engines "
+                        "thread per-superstep counter planes (bit-"
+                        "exact; results are mode-independent), the "
+                        "journal dir gains metrics.jsonl + a Perfetto "
+                        "trace.json of service spans")
+    p.add_argument("--trace-out", default=None,
+                   help="write the Perfetto trace here instead of "
+                        "<journal>/trace.json (needs --telemetry)")
 
 
 def _kw(args) -> dict:
+    if args.trace_out and args.telemetry == "off":
+        raise SystemExit("--trace-out needs --telemetry "
+                         "counters|full (off records nothing)")
     return dict(chunk=args.chunk, max_retries=args.retries,
                 backoff_us=args.backoff_us,
                 bucket_timeout_us=args.timeout_us,
                 grace_us=args.grace_us, max_bucket=args.max_bucket,
-                lint=args.lint, inject=args.inject)
+                lint=args.lint, inject=args.inject,
+                telemetry=args.telemetry, trace_out=args.trace_out)
 
 
 def _finish(svc: SweepService, verify: bool) -> int:
@@ -88,6 +103,9 @@ def _finish(svc: SweepService, verify: bool) -> int:
         print(json.dumps({"sweep": "killed", "error": str(e)}))
         return 1
     out = report.to_json()
+    if svc.trace_path is not None:
+        out["trace"] = svc.trace_path
+        out["metrics"] = svc.metrics.path
     if verify:
         mismatches = []
         for rid, res in sorted(report.done.items()):
@@ -150,6 +168,10 @@ def _status(argv) -> int:
         "pending": total - done - failed, "retries": scan.retries,
         "splits": {k: v for k, v in scan.splits.items()},
         "buckets_done": sorted(scan.bucket_done),
+        # per-bucket hardware utilization (sweep/runner.py): how well
+        # the batched executables were used — worlds-active occupancy,
+        # budget-mask efficiency, pow2 scan-pad waste
+        "utilization": scan.util,
         "pack_sha": scan.pack_sha}))
     return 0
 

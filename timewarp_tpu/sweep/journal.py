@@ -52,6 +52,9 @@ class JournalState:
     splits: Dict[str, List[str]] = field(default_factory=dict)
     retries: int = 0
     events: List[dict] = field(default_factory=list)
+    #: bucket_id -> utilization record (obs: worlds-active occupancy,
+    #: budget-mask efficiency, pow2 pad waste — sweep/runner.py)
+    util: Dict[str, dict] = field(default_factory=dict)
 
 
 class SweepJournal:
@@ -60,6 +63,12 @@ class SweepJournal:
         self.path = os.path.join(root, "journal.jsonl")
         self.pack_path = os.path.join(root, "pack.json")
         self._fh = None
+        #: optional observability hook: called as ``on_append(ev,
+        #: wall_s)`` after every durable append — the sweep service
+        #: wires it to the Perfetto timeline so fsync stalls are
+        #: visible (obs/perfetto.py). Purely additive: the append's
+        #: durability contract does not depend on it.
+        self.on_append = None
 
     # -- writing -----------------------------------------------------------
 
@@ -80,12 +89,17 @@ class SweepJournal:
         """Durable append: the record is on disk (flushed + fsync'd)
         before this returns — the crash-safety contract every caller
         leans on."""
+        import time as _time
+        t0 = _time.perf_counter()
         if self._fh is None:
             self.ensure_dir()
             self._fh = open(self.path, "a")
         self._fh.write(json.dumps(rec, sort_keys=True) + "\n")
         self._fh.flush()
         os.fsync(self._fh.fileno())
+        if self.on_append is not None:
+            self.on_append(rec.get("ev", "?"),
+                           _time.perf_counter() - t0)
 
     def close(self) -> None:
         if self._fh is not None:
@@ -160,6 +174,12 @@ class SweepJournal:
                 st.bucket_done.add(rec["bucket"])
             elif ev == "bucket_split":
                 st.splits[rec["bucket"]] = list(rec["into"])
+            elif ev == "bucket_util":
+                # a resumed bucket re-journals its (process-local)
+                # utilization; last record wins — wall facts are not
+                # replayable, only results are
+                st.util[rec["bucket"]] = {
+                    k: v for k, v in rec.items() if k != "ev"}
             elif ev == "retry":
                 st.retries += 1
         return st

@@ -33,6 +33,7 @@ never corrupt the retry's digest chain or double-journal a world.
 
 from __future__ import annotations
 
+import contextlib
 import os
 import threading
 from typing import Dict, List, Optional, Set
@@ -55,7 +56,8 @@ class StaleAttempt(RuntimeError):
 class BucketRunner:
     def __init__(self, bucket: Bucket, journal: SweepJournal,
                  done: Dict[str, dict], *, lint: str = "warn",
-                 chunk: int = 64, inject=None) -> None:
+                 chunk: int = 64, inject=None,
+                 telemetry: str = "off", metrics=None) -> None:
         self.bucket = bucket
         self.journal = journal
         #: shared run_id -> result map (journaled results land here
@@ -64,6 +66,10 @@ class BucketRunner:
         self.lint = lint
         self.chunk = int(chunk)
         self.inject = inject
+        #: engine telemetry mode + optional obs.metrics.MetricsRegistry
+        #: (the engine chunk-flushes `supersteps` lines into it)
+        self.telemetry = telemetry
+        self.metrics = metrics
         self.attempts = 0
         #: attempt generation (module docstring): bumped by
         #: begin_attempt and by abandon, so a zombie thread's stamped
@@ -75,6 +81,18 @@ class BucketRunner:
         self.digests: Optional[List[str]] = None
         self.supersteps: Optional[List[int]] = None
         self.emitted: Optional[Set[str]] = None
+        #: wall seconds this process has spent running the bucket's
+        #: chunks (stamped onto world_done records — observability
+        #: metadata OUTSIDE the result dict, so the sweep survival law
+        #: and resume's replay-equality check never see it)
+        self.wall_s = 0.0
+        #: hardware-utilization accumulators (journaled as a
+        #: `bucket_util` record when the bucket completes): how much
+        #: of the batched executable's width and pow2-padded scan
+        #: length did real (unmasked, unpadded) supersteps use
+        self.util = {"chunks": 0, "world_supersteps": 0,
+                     "scan_supersteps": 0, "pad_supersteps": 0,
+                     "active_world_chunks": 0}
 
     # -- attempt lifecycle (called from the event-loop thread) -----------
 
@@ -107,7 +125,9 @@ class BucketRunner:
         self._check(epoch)
         engine = self.engine
         if engine is None:
-            engine = build_bucket_engine(self.bucket, lint=self.lint)
+            engine = build_bucket_engine(self.bucket, lint=self.lint,
+                                         telemetry=self.telemetry)
+            engine.metrics = self.metrics
         path = self.journal.checkpoint_path(self.bucket.bucket_id)
         B = self.bucket.B
         if os.path.exists(path):
@@ -162,32 +182,105 @@ class BucketRunner:
                                supersteps[int(b)])
             with self._lock:
                 self._check(epoch)
+                # wall_s / attempts are observability metadata on the
+                # RECORD, deliberately outside "result": the sweep
+                # survival law (and resume's replayed-record equality)
+                # compare results, which must stay bit-deterministic
                 self.journal.append({"ev": "world_done",
                                      "bucket": self.bucket.bucket_id,
+                                     "wall_s": round(self.wall_s, 6),
+                                     "attempts": self.attempts,
                                      "result": res})
                 self.done[cfg.run_id] = res
                 self.emitted.add(cfg.run_id)
         if not active.any():
+            self._finish_util(epoch)
             return "done"
         vec = np.where(active, np.minimum(remaining, self.chunk), 0)
-        new_state, traces = eng.run(vec, state=st)
+        import time as _time
+        from ..interp.jax_engine.common import scan_pad
+        from ..obs.profiler import annotate
+        _t0 = _time.perf_counter()
+        with annotate(f"sweep bucket {self.bucket.bucket_id}"):
+            new_state, traces = eng.run(vec, state=st)
+        chunk_wall = _time.perf_counter() - _t0
         for b in range(B):
             digests[b] = chain_digest(digests[b], traces[b])
             supersteps[b] += len(traces[b])
+        top = int(vec.max())
         with self._lock:
             self._check(epoch)
             self.state = new_state
             self.digests = digests
             self.supersteps = supersteps
+            self.wall_s += chunk_wall
+            # utilization bookkeeping: the fleet executed B ×
+            # scan_pad(top) superstep bodies for Σ len(traces[b]) real
+            # (unmasked) ones — the gap is pad waste + budget masking
+            u = self.util
+            u["chunks"] += 1
+            u["world_supersteps"] += sum(len(traces[b])
+                                         for b in range(B))
+            u["scan_supersteps"] += scan_pad(top)
+            u["pad_supersteps"] += scan_pad(top) - top
+            u["active_world_chunks"] += int(active.sum())
             from ..utils.checkpoint import save_state
-            save_state(
-                self.journal.checkpoint_path(self.bucket.bucket_id),
-                new_state,
-                meta={"bucket": self.bucket.bucket_id,
-                      "run_ids": list(self.bucket.run_ids),
-                      "digests": list(digests),
-                      "supersteps": [int(s) for s in supersteps]})
+            ckpt_cm = (self.metrics.span(
+                "checkpoint", bucket=self.bucket.bucket_id)
+                if self.metrics is not None
+                else contextlib.nullcontext())
+            with ckpt_cm:
+                save_state(
+                    self.journal.checkpoint_path(self.bucket.bucket_id),
+                    new_state,
+                    meta={"bucket": self.bucket.bucket_id,
+                          "run_ids": list(self.bucket.run_ids),
+                          "digests": list(digests),
+                          "supersteps": [int(s) for s in supersteps]})
         return "running"
+
+    def utilization(self) -> dict:
+        """The bucket's hardware-utilization record (module docstring
+        step 4's ledger): budget-mask efficiency = real supersteps /
+        (B × scan supersteps executed), pow2 pad waste, and mean
+        worlds-active occupancy per chunk. A resumed bucket reports
+        only the resumed process's chunks (wall-clock facts are not
+        replayable — the *results* are what the survival law pins)."""
+        u = self.util
+        B = self.bucket.B
+        scan_total = u["scan_supersteps"]
+        return {
+            "bucket": self.bucket.bucket_id,
+            "worlds": B,
+            "chunks": u["chunks"],
+            "world_supersteps": u["world_supersteps"],
+            "scan_supersteps": scan_total,
+            "budget_efficiency": round(
+                u["world_supersteps"] / (B * scan_total), 4)
+            if scan_total else 1.0,
+            "pad_waste_frac": round(
+                u["pad_supersteps"] / scan_total, 4)
+            if scan_total else 0.0,
+            "worlds_active_mean": round(
+                u["active_world_chunks"] / (u["chunks"] * B), 4)
+            if u["chunks"] else 0.0,
+            "wall_s": round(self.wall_s, 6),
+        }
+
+    def _finish_util(self, epoch: Optional[int]) -> None:
+        """Journal the bucket's utilization record once, when every
+        world's result has streamed — alongside (not inside) the
+        results, so `sweep status` can report hardware efficiency per
+        bucket without touching the survival law's compare surface."""
+        if self.util.get("_journaled"):
+            return
+        rec = self.utilization()
+        with self._lock:
+            self._check(epoch)
+            self.journal.append({"ev": "bucket_util", **rec})
+            self.util["_journaled"] = True
+        if self.metrics is not None:
+            self.metrics.emit("utilization", **rec)
 
     def split_children(self) -> List["BucketRunner"]:
         """The OOM degradation path: halve the bucket, slice the last
@@ -212,7 +305,9 @@ class BucketRunner:
         for child, idxs in parts:
             r = BucketRunner(child, self.journal, self.done,
                              lint=self.lint, chunk=self.chunk,
-                             inject=self.inject)
+                             inject=self.inject,
+                             telemetry=self.telemetry,
+                             metrics=self.metrics)
             if self.state is not None:
                 idx = np.asarray(idxs)
                 child_state = jax.tree.map(lambda x: x[idx], self.state)

@@ -186,7 +186,9 @@ class SweepService:
                  backoff_us: int = 50_000,
                  bucket_timeout_us: Optional[int] = None,
                  grace_us: int = 500_000, max_bucket: int = 64,
-                 lint: str = "warn", inject=None) -> None:
+                 lint: str = "warn", inject=None,
+                 telemetry: str = "off",
+                 trace_out: Optional[str] = None) -> None:
         if chunk < 1:
             raise ValueError(f"chunk must be >= 1, got {chunk}")
         if max_retries < 0:
@@ -202,6 +204,32 @@ class SweepService:
         self.lint = lint
         self.inject = (InjectPlan(inject) if isinstance(inject, str)
                        else inject)
+        # observability (obs/, docs/observability.md): when telemetry
+        # is on, the bucket engines thread counter planes through
+        # their scans (bit-exact — the streamed results are
+        # mode-independent), a MetricsRegistry streams
+        # `<journal>/metrics.jsonl`, and a TraceBuilder records the
+        # service's wall-clock spans (attempts, retries, backoffs,
+        # checkpoints, journal fsyncs) for Perfetto
+        import os as _os
+        from ..obs.telemetry import validate_mode
+        self.telemetry = validate_mode(telemetry, type(self).__name__)
+        self.trace_out = trace_out
+        self.trace_path = None
+        self.metrics = None
+        self.tracer = None
+        if self.telemetry != "off":
+            from ..obs.metrics import MetricsRegistry
+            from ..obs.perfetto import TraceBuilder
+            self.journal.ensure_dir()
+            self.tracer = TraceBuilder(process="timewarp-tpu sweep")
+            self.metrics = MetricsRegistry(
+                path=_os.path.join(journal_dir, "metrics.jsonl"),
+                run=f"sweep:{pack.sha()[:12]}", tracer=self.tracer)
+            self.journal.on_append = (
+                lambda ev, dt: self.tracer.complete(
+                    f"journal fsync: {ev}", dur_us=dt * 1e6,
+                    cat="journal"))
         self.done: Dict[str, dict] = {}
         self.failed: Dict[str, dict] = {}
         self._retries = 0
@@ -261,7 +289,8 @@ class SweepService:
                     continue
                 queue.append(BucketRunner(
                     bucket, self.journal, self.done, lint=self.lint,
-                    chunk=self.chunk, inject=self.inject))
+                    chunk=self.chunk, inject=self.inject,
+                    telemetry=self.telemetry, metrics=self.metrics))
         self._planned = len(queue)
         return queue
 
@@ -353,6 +382,9 @@ class SweepService:
                    "attempts": runner.attempts, "error": reason}
             self.journal.append(rec)
             self.failed[cfg.run_id] = rec
+            if self.metrics is not None:
+                self.metrics.event("world_failed", run_id=cfg.run_id,
+                                   bucket=runner.bucket.bucket_id)
             _log.error("sweep: world %r TERMINALLY FAILED after %d "
                        "attempt(s): %s", cfg.run_id, runner.attempts,
                        reason)
@@ -364,7 +396,17 @@ class SweepService:
             self.journal.append({"ev": "bucket_start",
                                  "bucket": runner.bucket.bucket_id,
                                  "attempt": runner.attempts + 1})
+            _t0 = _time.perf_counter()
+            _ts = None if self.tracer is None else self.tracer.now_us()
             out = yield from self._attempt(jc, runner)
+            if self.tracer is not None:
+                self.tracer.complete(
+                    f"attempt: bucket {runner.bucket.bucket_id}",
+                    dur_us=(_time.perf_counter() - _t0) * 1e6,
+                    ts_us=_ts, cat="attempt",
+                    args={"attempt": runner.attempts,
+                          "ok": out.ok,
+                          "timed_out": out.timed_out})
             if out.ok:
                 self.journal.append({"ev": "bucket_done",
                                      "bucket": runner.bucket.bucket_id})
@@ -374,6 +416,10 @@ class SweepService:
                 raise err  # the injected hard kill: abort the process
             if err is not None and _is_oom(err):
                 if runner.bucket.B > 1:
+                    if self.metrics is not None:
+                        self.metrics.event(
+                            "oom_split",
+                            bucket=runner.bucket.bucket_id)
                     kids = yield from self._io(runner.split_children)
                     self.journal.append({
                         "ev": "bucket_split",
@@ -406,7 +452,16 @@ class SweepService:
                              "— retrying after %d µs",
                              runner.bucket.bucket_id, runner.attempts,
                              reason, backoff)
+                _bt = None if self.tracer is None \
+                    else self.tracer.now_us()
                 yield Wait(int(backoff))
+                if self.tracer is not None:
+                    self.tracer.complete(
+                        f"backoff: bucket {runner.bucket.bucket_id}",
+                        dur_us=self.tracer.now_us() - _bt, ts_us=_bt,
+                        cat="retry",
+                        args={"attempt": runner.attempts,
+                              "reason": reason})
                 queue.appendleft(runner)
             else:
                 self._terminal_failure(
@@ -437,6 +492,28 @@ class SweepService:
             return report
         finally:
             self.journal.close()
+            if self.tracer is not None:
+                # the Perfetto timeline survives kills too: written in
+                # the finally, so a die:K abort still leaves the spans
+                # up to the kill on disk. Best-effort: the sweep's
+                # outcome (report, --verify, the killed path) must
+                # never be masked by its own instrumentation failing
+                # to write (a bad --trace-out dir, a full disk)
+                import os as _os
+                path = self.trace_out or _os.path.join(
+                    self.journal.root, "trace.json")
+                try:
+                    self.tracer.save(path)
+                    self.trace_path = path
+                except OSError as e:
+                    _log.warning("sweep: could not write Perfetto "
+                                 "trace %r (%s) — results are "
+                                 "unaffected", path, e)
+            if self.metrics is not None:
+                try:
+                    self.metrics.close()
+                except OSError as e:
+                    _log.warning("sweep: metrics close failed: %s", e)
             if self._executor is not None:
                 # never join: an abandoned wedged chunk must not keep
                 # a finished (or killed) sweep from returning
