@@ -39,6 +39,17 @@ Configs (select with TW_BENCH_CONFIG, default ``token_ring_dense``):
   batched engine, reporting AGGREGATE delivered-msg/s/chip. Gated
   in-bench by the batch exactness law (world-b slice ≡ solo run,
   bit-for-bit) before the measured run counts.
+- ``gossip_100k_insert`` / ``praos_1m_insert`` — the general engine
+  with ``insert="pallas"`` (pallas_insert.py, round 12): the
+  fire-compaction kernel replaces the sender-compaction sort +
+  rung-width gathers and the in-tile insertion kernel replaces the
+  mailbox scatters. Gated in-bench by bit-exact state equality
+  against ``insert="xla"``; the JSON line additionally reports the
+  isolated per-superstep insert-stage time for both strategies and
+  the achieved-bytes / HBM-roofline fraction (``TW_HBM_GBPS``,
+  default 270). On CPU the kernels run under the Pallas interpreter
+  (``insert="interpret"`` — SMOKE-able; the stage timings then carry
+  the cpu-platform caveat via the env fields).
 - ``sweep_hetero`` — the fault-tolerant sweep service (sweep/,
   docs/sweeps.md) on a heterogeneous pack with one injected transient
   failure: aggregate delivered-msg/s THROUGH the service (journal +
@@ -315,16 +326,113 @@ def _telemetry_gate(make_engine, steps=24, reps=3):
     return overhead
 
 
-def _assert_fused_sparse_exact(fused, ref, gate_steps=12):
-    """The fused-sparse engine's in-bench exactness gate: the XLA
-    general engine must reproduce the fused EngineState BIT-FOR-BIT
-    over the gate horizon before any measured run counts
-    (tests/test_fused_sparse.py is the CPU-side law; this runs it on
-    the bench hardware)."""
+def _insert_mode():
+    """The ``insert=`` value for this bench platform: the real kernels
+    on TPU, the Pallas interpreter elsewhere (same semantics — the
+    exactness gate still gates; the measured numbers then carry the
+    cpu caveat in the env fields)."""
+    return "pallas" if jax.default_backend() == "tpu" else "interpret"
+
+
+def _assert_engines_exact(eng, ref, tag, gate_steps=12):
+    """The ONE in-bench engine-pair exactness gate: ``ref`` must
+    reproduce ``eng``'s EngineState BIT-FOR-BIT over the gate horizon
+    before any measured run counts (the CPU-side laws live in
+    tests/test_fused_sparse.py / tests/test_pallas_insert.py; this
+    runs them on the bench hardware)."""
     from timewarp_tpu.trace.events import assert_states_equal
-    fs = fused.run_quiet(gate_steps)
+    es = eng.run_quiet(gate_steps)
     rs = ref.run_quiet(gate_steps)
-    assert_states_equal(rs, fs, "in-bench fused-sparse gate")
+    assert_states_equal(rs, es, tag)
+
+
+def _assert_insert_exact(pallas, ref, gate_steps=12):
+    """The insert= knob's gate: insert="xla" vs the pallas kernels."""
+    _assert_engines_exact(pallas, ref, "in-bench pallas-insert gate",
+                          gate_steps)
+
+
+def _insert_stage_stats(engine, ref, reps=8):
+    """Isolated per-superstep insert-stage timing + achieved-bytes /
+    HBM-roofline fraction for the BENCH_SCHEMA=1 JSON line (ISSUE 8
+    satellite): a jitted call of each engine's own ``_insert_sorted``
+    on one synthetic destination-sorted batch at the pallas stage's
+    static width, against this scenario's empty mailbox. Bytes model:
+    every mailbox plane read + written once, the resident batch read
+    once — the kernel's streaming contract. The roofline constant is
+    ``TW_HBM_GBPS`` (default 270 — the r5 dense-ring HBM floor,
+    ~40 MB / 0.15 ms, PERF_r05.md). Caveats recorded with the number:
+    each rep pays one host sync (~the tunnel RTT on a tunneled chip —
+    treat sub-ms values as upper bounds; the floor-subtracted
+    device-loop version is profiling/insert_stage_r06.py), and on CPU
+    the fraction is not a roofline statement at all (the env fields
+    say where the line ran)."""
+    import statistics
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    sc = engine.scenario
+    n, K, P = sc.n_nodes, sc.mailbox_cap, sc.payload_width
+    S = engine._pallas_stage.S
+    rng = np.random.RandomState(0)
+    sd = jnp.asarray(np.sort(rng.randint(0, n, size=S))
+                     .astype(np.int32))
+    drel = jnp.asarray(rng.randint(1, 1 << 20, size=S)
+                       .astype(np.int32))
+    src = jnp.asarray(rng.randint(0, n, size=S).astype(np.int32))
+    pay = tuple(jnp.asarray(rng.randint(0, 1 << 20, size=S)
+                            .astype(np.int32)) for _ in range(P))
+    ok = sd < n
+    st = engine.init_state()
+    if sc.commutative_inbox:
+        # empty-mailbox free-slot table, in the engine's own dtype
+        # rule (engine.py _superstep step 5: int8 when K fits)
+        fr_dt = jnp.int8 if K <= 127 else jnp.int32
+        free_rows = jnp.broadcast_to(
+            jnp.arange(K, dtype=fr_dt)[:, None], (K, n))
+        counts = None
+    else:
+        free_rows = None
+        counts = jnp.zeros(n, jnp.int32)
+
+    def timed(eng):
+        # block on the FULL return (mb_rel, mb_src, mb_payload,
+        # overflow): keeping only one output would let XLA dead-code
+        # the src/payload scatters out of the xla leg while the
+        # pallas_call always runs whole — a structurally biased
+        # comparison
+        f = jax.jit(lambda mb_rel, mb_src, mb_pay: eng._insert_sorted(
+            mb_rel, mb_src, mb_pay, sd, ok, drel, src, pay,
+            free_rows, counts))
+        jax.block_until_ready(f(st.mb_rel, st.mb_src, st.mb_payload))
+        walls = []
+        for _ in range(max(3, reps)):
+            t0 = time.perf_counter()
+            jax.block_until_ready(
+                f(st.mb_rel, st.mb_src, st.mb_payload))
+            walls.append(time.perf_counter() - t0)
+        return statistics.median(walls)
+
+    t_pal, t_xla = timed(engine), timed(ref)
+    planes = K * (1 + P + (1 if sc.inbox_src else 0))
+    bytes_step = 2 * planes * n * 4 + (3 + P) * S * 4
+    gbps = float(os.environ.get("TW_HBM_GBPS", "270"))
+    return {
+        "insert_stage_ms": round(t_pal * 1e3, 4),
+        "insert_stage_xla_ms": round(t_xla * 1e3, 4),
+        "insert_bytes_per_step": bytes_step,
+        "insert_hbm_frac": round(bytes_step / t_pal / (gbps * 1e9), 4),
+        "hbm_gbps_assumed": gbps,
+        "insert_resolved": engine.insert_resolved,
+    }
+
+
+def _assert_fused_sparse_exact(fused, ref, gate_steps=12):
+    """The fused-sparse engine's gate: the XLA general engine vs the
+    fused kernel."""
+    _assert_engines_exact(fused, ref, "in-bench fused-sparse gate",
+                          gate_steps)
 
 
 def bench_gossip_100k(n, steps):
@@ -378,6 +486,61 @@ def bench_gossip_100k_fused(n, steps):
             f"pallas) delivered-messages/sec/chip @{n} nodes",
             delivered / dt,
             {"telemetry_overhead_frac": round(overhead, 4)})
+
+
+def bench_gossip_100k_insert(n, steps):
+    """The gossip wave on the general engine with ``insert="pallas"``
+    (pallas_insert.py): fire-compaction emits the compact fired batch
+    in one streamed pass (no sender-compaction N-sort, no rung
+    gathers) and the insertion kernel streams the mailbox planes
+    through VMEM once. Gated in-bench by bit-exact state equality
+    against ``insert="xla"``; reports the isolated insert-stage
+    timings + roofline fraction. Default n is 2^17 (the kernels'
+    1024-lane planes — 100k is not a multiple)."""
+    from timewarp_tpu.interp.jax_engine.engine import JaxEngine
+
+    n = n or (1 << 17)
+    sc, link = _gossip_wave(n)
+    # insert_cap bounds the VMEM-resident fire-compacted batch (the
+    # fused engine's max_batch analog); a wave peak beyond it lands in
+    # route_drop and fails _assert_wave_done loudly — never a silently
+    # wrong number
+    cap = min(1 << 18, n * sc.max_out)
+    engine = JaxEngine(sc, link, window="auto", insert=_insert_mode(),
+                       insert_cap=cap)
+    ref = JaxEngine(sc, link, window="auto")
+    _assert_insert_exact(engine, ref)
+    extra = _insert_stage_stats(engine, ref)
+    delivered, dt, fin = _measure(engine, steps or (1 << 20))
+    _assert_wave_done(engine, fin, n)
+    return (f"gossip broadcast wave to quiescence (pallas "
+            f"insert) delivered-messages/sec/chip @{n} nodes",
+            delivered / dt, extra)
+
+
+def bench_praos_1m_insert(n, steps):
+    """Praos on the general engine with ``insert="pallas"`` — the
+    profiled hotspot (PERF_r05.md "where the remaining praos fat is")
+    the kernels exist for. Same gates and stage stats as
+    gossip_100k_insert."""
+    from timewarp_tpu.interp.jax_engine.engine import JaxEngine
+
+    n = n or 1 << 20
+    sc, link = _praos_consensus(n)
+    cap = min(1 << 17, n * sc.max_out)
+    engine = JaxEngine(sc, link, window="auto", insert=_insert_mode(),
+                       insert_cap=cap)
+    ref = JaxEngine(sc, link, window="auto")
+    _assert_insert_exact(engine, ref)
+    extra = _insert_stage_stats(engine, ref)
+    delivered, dt, fin = _measure(engine, steps or 256, warm_steps=16)
+    assert int(fin.short_delay) == 0, \
+        "windowed run left the exact regime"
+    assert int(fin.route_drop) == 0, \
+        "fire-compacted batch cap dropped messages — raise insert_cap"
+    return (f"praos slot-leader consensus (pallas insert) "
+            f"delivered-messages/sec/chip @{n} stake nodes",
+            delivered / dt, extra)
 
 
 def bench_gossip_100k_b8(n, steps):
@@ -674,11 +837,13 @@ CONFIGS = {
     "token_ring_observer": bench_token_ring_observer,
     "gossip_100k": bench_gossip_100k,
     "gossip_100k_fused": bench_gossip_100k_fused,
+    "gossip_100k_insert": bench_gossip_100k_insert,
     "gossip_100k_b8": bench_gossip_100k_b8,
     "gossip_100k_chaos": bench_gossip_100k_chaos,
     "gossip_steady_1m": bench_gossip_steady_1m,
     "praos_1m": bench_praos_1m,
     "praos_1m_fused": bench_praos_1m_fused,
+    "praos_1m_insert": bench_praos_1m_insert,
     "praos_1m_b4": bench_praos_1m_b4,
     "sweep_hetero": bench_sweep_hetero,
 }
@@ -692,11 +857,13 @@ SMOKE = {
     "token_ring_observer": (1024, 32),
     "gossip_100k": (2048, 1 << 14),
     "gossip_100k_fused": (2048, 1 << 14),
+    "gossip_100k_insert": (2048, 1 << 14),
     "gossip_100k_b8": (1024, 1 << 14),
     "gossip_100k_chaos": (1024, 1 << 14),
     "gossip_steady_1m": (4096, 16),
     "praos_1m": (2048, 24),
     "praos_1m_fused": (2048, 24),
+    "praos_1m_insert": (2048, 24),
     "praos_1m_b4": (1024, 24),
     "sweep_hetero": (256, 96),
 }

@@ -1,0 +1,189 @@
+"""Round-12 insert-stage microbench: the Pallas insertion kernel vs
+the XLA scatter baselines, RTT-corrected.
+
+The on-chip hook for ISSUE 8's acceptance: isolate the mailbox
+insertion stage at the praos bench shape and time, floor-subtracted
+inside a REPS-iteration device loop (the r5 methodology — every host
+sync through the tunnel costs ~110 ms, so per-op numbers must come
+from device loops with the no-op floor subtracted,
+profiling/micro2_r05.py):
+
+- ``insert_xla``   — flat 1D scatters (the engine default; pays the
+  tiled-[K, N] relayout copy at the scatter operand, PERF_r05.md §3);
+- ``insert_xla2d`` — the 2D [col, row] scatter form (no relayout, ~7x
+  the flat scatter in isolation — the baseline the kernel must beat);
+- ``insert_pallas`` — the in-tile insertion kernel (pallas_insert.py:
+  mailbox planes streamed through VMEM once, holes ranked in-tile);
+- ``firecompact``  — the fire-compaction kernel alone (the front end
+  that replaces the sender-compaction N-sort + rung-width gathers);
+- ``ladder_front`` — the XLA front end it replaces (sender sort +
+  top-rung gathers), for the head-to-head.
+
+Each line reports achieved GB/s against the streaming bytes model and
+the fraction of the assumed HBM roofline (``TW_HBM_GBPS``, default
+270 — the r5 dense-ring floor implies ~266 GB/s on this chip). On a
+CPU host the kernels run under the Pallas interpreter: the timings
+are then NOT hardware statements (the JSON says platform=cpu) — run
+this on a chip-attached round and paste the lines into the PERF
+notes (PERF_r06.md records the CPU-only caveat until then).
+
+Env knobs: TW_NODES (default 2^20), TW_MAXOUT (8), TW_CAP (mailbox
+cap, 16), TW_PAYLOAD (2), TW_BATCH (resident batch messages, 2^17),
+TW_REPS (64), TW_HBM_GBPS (270).
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from timewarp_tpu.utils import jaxconfig  # noqa: F401
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+N = int(os.environ.get("TW_NODES", 1 << 20))
+M = int(os.environ.get("TW_MAXOUT", 8))
+K = int(os.environ.get("TW_CAP", 16))
+P = int(os.environ.get("TW_PAYLOAD", 2))
+S = int(os.environ.get("TW_BATCH", 1 << 17))
+REPS = int(os.environ.get("TW_REPS", 64))
+GBPS = float(os.environ.get("TW_HBM_GBPS", 270))
+
+_floor_ms = 0.0
+
+
+def loop(name, fn, state, bytes_step, note=""):
+    """Device-loop timing with the no-op floor subtracted: ``fn(state,
+    i) -> state`` runs REPS times inside one jitted fori_loop; the
+    readback at the end is the single host sync."""
+    global _floor_ms
+
+    def rep(state):
+        return lax.fori_loop(jnp.int32(0), jnp.int32(REPS),
+                             lambda i, s: fn(s, i), state)
+
+    f = jax.jit(rep)
+    out = f(state)
+    int(jnp.asarray(jax.tree.leaves(out)[0]).reshape(-1)[0] % 997)
+    best = 1e9
+    for _ in range(2):
+        t0 = time.perf_counter()
+        out = f(state)
+        int(jnp.asarray(jax.tree.leaves(out)[0]).reshape(-1)[0] % 997)
+        best = min(best, (time.perf_counter() - t0) / REPS)
+    ms = best * 1e3
+    if name == "noop":
+        _floor_ms = ms
+        print(json.dumps({"op": name, "raw_ms": round(ms, 4)}))
+        return
+    net = max(ms - _floor_ms, 1e-6)
+    gbs = bytes_step / (net * 1e-3) / 1e9
+    print(json.dumps({
+        "op": name, "ms": round(net, 4), "raw_ms": round(ms, 4),
+        "achieved_gbps": round(gbs, 1),
+        "hbm_frac": round(gbs / GBPS, 4),
+        **({"note": note} if note else {})}))
+
+
+def main():
+    from timewarp_tpu.interp.jax_engine.engine import JaxEngine
+    from timewarp_tpu.models.praos import praos
+    from timewarp_tpu.net.delays import Quantize, UniformDelay
+
+    sc = praos(N, slot_us=1_000_000, n_slots=1 << 30,
+               leader_prob=4.0 / N, fanout=M, burst=True,
+               mailbox_cap=K)
+    link = Quantize(UniformDelay(8_000, 30_000), 1_000)
+    mode = "pallas" if jax.default_backend() == "tpu" else "interpret"
+    engines = {
+        "insert_xla": JaxEngine(sc, link, window="auto", lint="off"),
+        "insert_xla2d": JaxEngine(sc, link, window="auto",
+                                  lint="off", insert="xla2d"),
+        "insert_pallas": JaxEngine(
+            sc, link, window="auto", lint="off", insert=mode,
+            insert_cap=min(S, N * sc.max_out)),
+    }
+    Pw = sc.payload_width
+    SS = engines["insert_pallas"]._pallas_stage.S
+    rng = np.random.RandomState(0)
+    sd = jnp.asarray(np.sort(rng.randint(0, N, size=SS))
+                     .astype(np.int32))
+    src = jnp.asarray(rng.randint(0, N, size=SS).astype(np.int32))
+    pay = tuple(jnp.asarray(rng.randint(0, 1 << 20, size=SS)
+                            .astype(np.int32)) for _ in range(Pw))
+    ok = sd < N
+    fr_dt = jnp.int8 if K <= 127 else jnp.int32
+    free_rows = jnp.broadcast_to(
+        jnp.arange(K, dtype=fr_dt)[:, None], (K, N))
+    st = engines["insert_xla"].init_state()
+    planes = K * (1 + Pw + (1 if sc.inbox_src else 0))
+    ins_bytes = 2 * planes * N * 4 + (3 + Pw) * SS * 4
+
+    print(json.dumps({"config": {
+        "n": N, "max_out": M, "mailbox_cap": K, "payload": Pw,
+        "batch": SS, "reps": REPS, "hbm_gbps_assumed": GBPS,
+        "platform": jax.default_backend(), "insert_mode": mode}}))
+    loop("noop", lambda s, i: s, st.mb_rel, 0)
+
+    for name, eng in engines.items():
+        def body(mb_rel, i, eng=eng):
+            # vary drel per iteration so the loop cannot CSE
+            drel = (sd * jnp.int32(1103515245) + i).astype(jnp.int32) \
+                | jnp.int32(1)
+            out = eng._insert_sorted(
+                mb_rel, st.mb_src, st.mb_payload, sd, ok,
+                jnp.abs(drel) % jnp.int32(1 << 20) + 1, src, pay,
+                free_rows, None)
+            return out[0]
+        loop(name, body, st.mb_rel, ins_bytes)
+
+    # the two front ends, head-to-head: fire-compaction kernel vs the
+    # sender sort + top-rung gathers it replaces
+    peng = engines["insert_pallas"]
+    stage = peng._pallas_stage
+    pdst0 = jnp.where(
+        jnp.asarray(rng.rand(M, N) < (SS / (2.0 * M * N))),
+        jnp.asarray(rng.randint(0, N, size=(M, N)).astype(np.int32)),
+        jnp.int32(-1))
+    payload = jnp.asarray(
+        rng.randint(0, 1 << 20, size=(M, Pw, N)).astype(np.int32))
+    woff_n = jnp.zeros((N,), jnp.int32)
+    fc_bytes = (M * (1 + Pw) * N + (3 + Pw) * SS) * 4
+
+    def fc_body(acc, i):
+        pdst = jnp.where(pdst0 >= 0, (pdst0 + i) % jnp.int32(N),
+                         jnp.int32(-1))
+        d, w, smr, pc, drop = stage.compact(pdst, woff_n, payload)
+        return acc + d[:1] + drop
+    loop("firecompact", fc_body, jnp.zeros((1,), jnp.int32), fc_bytes)
+
+    node_ids = jnp.arange(N, dtype=jnp.int32)
+    lf_bytes = (N + M * (1 + Pw) * N + (2 + Pw) * SS) * 4
+
+    def ladder_body(acc, i):
+        pdst = jnp.where(pdst0 >= 0, (pdst0 + i) % jnp.int32(N),
+                         jnp.int32(-1))
+        live = jnp.any(pdst >= 0, axis=0)
+        sid_sorted = lax.sort(jnp.where(live, node_ids, jnp.int32(N)))
+        A = SS // M
+        sids = lax.slice_in_dim(sid_sorted, 0, A)
+        real = sids < N
+        sidc = jnp.where(real, sids, 0)
+        dst_a = jnp.take(pdst, sidc, axis=1)
+        pay_a = [jnp.take(payload[:, p, :], sidc, axis=1)
+                 for p in range(Pw)]
+        return acc + dst_a[0, :1] + sum(p[0, :1] for p in pay_a)
+    loop("ladder_front", ladder_body, jnp.zeros((1,), jnp.int32),
+         lf_bytes,
+         note="sender sort + top-rung gathers (what firecompact "
+              "replaces)")
+
+
+if __name__ == "__main__":
+    main()

@@ -16,9 +16,11 @@ def test_bench_config_runs(cfg):
     n = {"token_ring_dense": 512, "token_ring_dense_xla": 512,
          "token_ring_observer": 256,
          "gossip_100k": 512, "gossip_100k_fused": 2048,
+         "gossip_100k_insert": 2048,
          "gossip_100k_b8": 512, "gossip_100k_chaos": 512,
          "gossip_steady_1m": 512,
          "praos_1m": 512, "praos_1m_fused": 2048,
+         "praos_1m_insert": 2048,
          "praos_1m_b4": 512, "sweep_hetero": 256}[cfg]
     # the gossip waves run to quiescence and assert they got there;
     # the sweep-service config takes per-world budgets, not a window

@@ -230,6 +230,20 @@ def build_engine(args, sc, link):
         raise SystemExit(
             f"--max-batch sizes the fused-sparse engine's "
             f"VMEM-resident batch; {args.engine} does not hold one")
+    # never-silent: the insert knob is the single-chip general
+    # engine's insertion-strategy selector (pallas_insert.py) — other
+    # engines replace the insertion stage themselves
+    if args.engine != "general" and getattr(args, "insert", None):
+        raise SystemExit(
+            f"--insert selects the general engine's insertion "
+            f"strategy (docs/engines.md); {args.engine} owns its "
+            "insertion stage (fused/sharded kernels)")
+    if args.engine != "general" and getattr(args, "insert_cap",
+                                            None) is not None:
+        raise SystemExit(
+            "--insert-cap sizes the general engine's fire-compacted "
+            f"batch (--insert pallas|interpret); {args.engine} does "
+            "not hold one")
     if args.engine == "oracle":
         from .interp.ref.superstep import SuperstepOracle
         return SuperstepOracle(sc, link, seed=args.seed,
@@ -241,7 +255,9 @@ def build_engine(args, sc, link):
                          route_cap=args.route_cap,
                          record_events=args.record_events,
                          lint=args.lint, batch=batch, faults=faults,
-                         telemetry=telemetry)
+                         telemetry=telemetry,
+                         insert=getattr(args, "insert", None),
+                         insert_cap=getattr(args, "insert_cap", None))
     if args.engine == "sharded-batched":
         from .interp.jax_engine.sharded import (ShardedBatchedEngine,
                                                 make_mesh)
@@ -492,6 +508,24 @@ def main(argv=None) -> int:
                    help="fused-sparse: VMEM-resident message batch "
                         "bound per superstep (excess counted in "
                         "route_drop, never silent)")
+    p.add_argument("--insert", default=None,
+                   choices=["xla", "xla2d", "pallas", "interpret"],
+                   help="general engine insertion strategy "
+                        "(docs/engines.md; every choice is "
+                        "bit-identical): 'xla' flat scatters "
+                        "(default), 'xla2d' the 2D scatter form (the "
+                        "promoted TW_FLAT_SCATTER hatch), 'pallas' "
+                        "the fire-compaction + in-tile insertion "
+                        "kernels on TPU (auto-fallback to xla "
+                        "elsewhere), 'interpret' the kernels under "
+                        "the Pallas interpreter; unset reads "
+                        "TW_INSERT")
+    p.add_argument("--insert-cap", type=int, default=None,
+                   help="--insert pallas|interpret: VMEM-resident "
+                        "fire-compacted batch bound in messages per "
+                        "superstep (default n_nodes*max_out = can "
+                        "never drop; excess counted in route_drop, "
+                        "never silent)")
     p.add_argument("--fanout", type=int, default=8)
     p.add_argument("--slots", type=int, default=10)
     p.add_argument("--leader-prob", type=float, default=0.05)

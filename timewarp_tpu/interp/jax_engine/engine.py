@@ -179,6 +179,28 @@ class JaxEngine(RunStatsMixin):
     knob needs hand-tuning. Event semantics, arrival order (contract
     #3) and digests are identical to the eager path.
 
+    Insertion strategy (``insert=``, round 12 — pallas_insert.py,
+    docs/engines.md): the mailbox-insertion stage is selectable and
+    **every choice is bit-identical** (state, traces, digests,
+    counters — under faults, with telemetry on, and on the world
+    axis; tests/test_pallas_insert.py. The one telemetry asymmetry:
+    the recorded ``rung`` column is strategy-denominated — ladder
+    rung vs the pallas path's static batch width — by the same
+    convention as the fused engine's VMEM slice).
+    ``"xla"`` (default) keeps the flat
+    1D scatters; ``"xla2d"`` the 2D [col, row] scatter form (the
+    promoted ``TW_FLAT_SCATTER`` escape hatch, PERF_r05.md §3);
+    ``"pallas"`` runs the fire-compaction + in-tile insertion kernels
+    on TPU (auto-fallback to ``"xla"`` off-TPU, recorded in
+    ``insert_fallback``) — in the adaptive regime the fire-compaction
+    kernel replaces the sender-compaction sort and rung-width gathers
+    wholesale (``_route_firecompact``); ``"interpret"`` forces the
+    kernels under the Pallas interpreter (the CPU test surface).
+    Unset, the knob reads the documented ``TW_INSERT`` env hatch.
+    ``insert_cap`` bounds the fire-compacted batch in messages
+    (default ``n_nodes * max_out`` — nothing can ever drop; a smaller
+    cap counts the excess in ``route_drop``, never silent).
+
     Batched multi-world execution (``batch=BatchSpec``, batched.py):
     a leading world axis B through the whole engine. ``_superstep`` is
     ``vmap``-ed over B independent worlds sharing one scenario but
@@ -218,7 +240,9 @@ class JaxEngine(RunStatsMixin):
                  lint: str = "warn",
                  batch: Optional[BatchSpec] = None,
                  faults=None,
-                 telemetry: str = "off") -> None:
+                 telemetry: str = "off",
+                 insert: Optional[str] = None,
+                 insert_cap: Optional[int] = None) -> None:
         # static scenario sanitizer (analysis/): "warn" logs findings,
         # "error" refuses to construct on contract violations, "off"
         # skips entirely (bit-for-bit the pre-lint behavior — the
@@ -329,10 +353,68 @@ class JaxEngine(RunStatsMixin):
             self._lpv = {k: jnp.asarray(v) for k, v in
                          (batch.link_params or {}).items()}
         self.comm = LocalComm(scenario.n_nodes)
+        # insertion-strategy knob (pallas_insert.py, round 12):
+        # "xla" (flat scatters, the r5 default) | "xla2d" (2D [col,
+        # row] scatter form — the promoted TW_FLAT_SCATTER escape
+        # hatch) | "pallas" (fire-compaction + in-tile insertion
+        # kernels on TPU; auto-fallback to "xla" elsewhere, recorded
+        # in ``insert_fallback``) | "interpret" (the kernels under the
+        # Pallas interpreter — the CPU test surface). insert=None
+        # reads the documented TW_INSERT env hatch (JaxEngine proper
+        # only: subclasses that replace the insertion stage themselves
+        # must not inherit it). Every strategy is bit-identical —
+        # the exactness law tests/test_pallas_insert.py pins.
+        from .pallas_insert import resolve_insert
+        (self.insert, self.insert_resolved, self.insert_fallback,
+         _ins_env) = resolve_insert(
+            insert, honor_env=type(self) is JaxEngine,
+            who=type(self).__name__)
+        # insert_cap sizes the pallas stage, so it needs a kernel mode
+        # — judged on the REQUESTED mode, not the resolved one: a
+        # script written for the chip (insert="pallas", insert_cap=N)
+        # must keep constructing through the documented off-TPU
+        # auto-fallback (the unused cap rides the recorded
+        # insert_fallback reason, never a crash)
+        if insert_cap is not None \
+                and self.insert not in ("pallas", "interpret"):
+            raise ValueError(
+                "insert_cap sizes the Pallas insertion stage's "
+                f"VMEM-resident batch; insert={self.insert!r} has none")
+        self._pallas_stage = None
+        if self.insert_resolved in ("pallas", "interpret"):
+            from .pallas_insert import PallasInsertStage
+            try:
+                # _adaptive_regime is the same predicate _superstep's
+                # routing dispatch tests — one implementation, so the
+                # VMEM budget is validated at construction for the
+                # width that will actually run
+                self._pallas_stage = PallasInsertStage(
+                    scenario, scenario.n_nodes, window=self.window,
+                    interpret=self.insert_resolved == "interpret",
+                    adaptive=self._adaptive_regime(),
+                    insert_cap=insert_cap, route_cap=self.route_cap)
+            except ValueError as e:
+                # an ENV-selected mode must stay behavior-neutral: a
+                # stale TW_INSERT cannot hard-fail a scenario outside
+                # the kernels' scope (e.g. a sweep bucket with
+                # n_nodes % 1024 != 0) — fall back, loudly recorded.
+                # Explicit insert= requests still refuse loudly.
+                if not _ins_env:
+                    raise
+                self.insert_resolved = "xla"
+                self.insert_fallback = (
+                    f"TW_INSERT={self.insert} is outside this "
+                    f"scenario's kernel scope ({e}) — fell back to "
+                    "'xla'")
+        if insert_cap is not None and self.insert_fallback is not None:
+            self.insert_fallback += "; insert_cap is unused on the " \
+                "xla fallback path"
         #: subclasses whose routing stage derives mailbox holes while
         #: the block is already in VMEM (fused_sparse.py) set this to
-        #: skip the [K, N] free-rows sort entirely
-        self._fused_holes = False
+        #: skip the [K, N] free-rows sort entirely — the pallas
+        #: insertion stage ranks holes in-tile the same way
+        self._fused_holes = (self._pallas_stage is not None
+                             and scenario.commutative_inbox)
 
     # -- faults (faults/: scheduled chaos inside the superstep) ----------
 
@@ -443,6 +525,18 @@ class JaxEngine(RunStatsMixin):
         never matters."""
         return ok, drel, src_f, dst_f, smrank, woff, pay_cols, jnp.int32(0)
 
+    def _adaptive_regime(self) -> bool:
+        """Whether routing takes the adaptive sender-compacted path
+        (class docstring) — the ONE predicate shared by _superstep's
+        routing dispatch and the pallas insertion stage's
+        construction-time width sizing (drift here would validate the
+        VMEM budget for the wrong width). Evaluated per call because
+        the sharded subclasses replace ``comm`` after construction."""
+        return (self.route_cap is None
+                and not self.link.can_drop
+                and type(self.comm) is LocalComm
+                and (self.window > 1 or self.scenario.max_out > 1))
+
     @staticmethod
     def _sender_rungs(n: int):
         """Geometric x2 ladder of static sender-count widths for the
@@ -487,14 +581,23 @@ class JaxEngine(RunStatsMixin):
                        drel_s, src_s, pay_s, free_rows, counts):
         """Shared mailbox insertion for destination-sorted messages:
         per-destination rank -> target slot (r-th hole for commutative
-        inboxes, append-after-kept otherwise) -> flat 1D scatters (the
-        2D [col, row] scatter form costs ~7x on this chip,
-        profiling/micro2_r05.py). Non-fitting lanes get an
-        out-of-range flat index and are dropped; returns the updated
-        arrays plus the local overflow count."""
+        inboxes, append-after-kept otherwise) -> scatters in the form
+        the ``insert`` knob selects: flat 1D (default — the 2D [col,
+        row] form costs ~7x on this chip, profiling/micro2_r05.py),
+        2D ``"xla2d"`` (no flat-reshape relayout copy of the tiled
+        mailbox — the promoted TW_FLAT_SCATTER hatch, PERF_r05.md §3),
+        or the Pallas insertion kernel (pallas_insert.py — streams the
+        [K, N] planes through VMEM once). Non-fitting lanes get an
+        out-of-range index and are dropped; returns the updated arrays
+        plus the local overflow count. All three forms are
+        bit-identical (tests/test_pallas_insert.py)."""
         sc = self.scenario
         K, P = sc.mailbox_cap, sc.payload_width
         n = self.comm.n_local
+        if self._pallas_stage is not None:
+            return self._pallas_stage.insert(
+                sd, drel_s, src_s, pay_s, mb_rel, mb_src, mb_payload,
+                counts)
         rank = group_rank(sd)
         if sc.commutative_inbox:
             # r-th incoming message takes the destination's r-th hole
@@ -507,24 +610,40 @@ class JaxEngine(RunStatsMixin):
             pos = counts[jnp.clip(sd, 0, n - 1)] + rank
             fits = ok_s & (pos < K)
             col = jnp.clip(pos, 0, K - 1)
-        flat = jnp.where(fits, col * jnp.int32(n) + sd,
-                         jnp.int32(K * n))
-        mb_rel = mb_rel.reshape(-1).at[flat].set(
-            drel_s, mode="drop").reshape(K, n)
-        if sc.inbox_src:
-            # inbox_src=False skips this whole scatter — mailbox
-            # scatters ARE the dense random-delivery cost floor
-            # (PERF_r04.md), so dropping an unread field is ~1/3 of it
-            mb_src = mb_src.reshape(-1).at[flat].set(
-                src_s, mode="drop").reshape(K, n)
-        mb_payload = mb_payload.reshape(-1)
-        for p in range(P):
-            flat_p = jnp.where(
-                fits, (col * jnp.int32(P) + p) * jnp.int32(n) + sd,
-                jnp.int32(K * P * n))
-            mb_payload = mb_payload.at[flat_p].set(pay_s[p],
-                                                   mode="drop")
-        mb_payload = mb_payload.reshape(K, P, n)
+        if self.insert_resolved == "xla2d":
+            # the 2D [col, row] scatter form: ~7x the flat form in
+            # isolation on this chip, but no physical relayout copy of
+            # the tiled [K, N] operand (PERF_r05.md §3 measured the
+            # two a wash in-engine) — kept selectable for hardware
+            # where the relayout dominates. Non-fitting lanes get an
+            # out-of-range row (K) and drop.
+            col2 = jnp.where(fits, col, jnp.int32(K))
+            mb_rel = mb_rel.at[col2, sd].set(drel_s, mode="drop")
+            if sc.inbox_src:
+                mb_src = mb_src.at[col2, sd].set(src_s, mode="drop")
+            for p in range(P):
+                mb_payload = mb_payload.at[col2, p, sd].set(
+                    pay_s[p], mode="drop")
+        else:
+            flat = jnp.where(fits, col * jnp.int32(n) + sd,
+                             jnp.int32(K * n))
+            mb_rel = mb_rel.reshape(-1).at[flat].set(
+                drel_s, mode="drop").reshape(K, n)
+            if sc.inbox_src:
+                # inbox_src=False skips this whole scatter — mailbox
+                # scatters ARE the dense random-delivery cost floor
+                # (PERF_r04.md), so dropping an unread field is ~1/3
+                # of it
+                mb_src = mb_src.reshape(-1).at[flat].set(
+                    src_s, mode="drop").reshape(K, n)
+            mb_payload = mb_payload.reshape(-1)
+            for p in range(P):
+                flat_p = jnp.where(
+                    fits, (col * jnp.int32(P) + p) * jnp.int32(n) + sd,
+                    jnp.int32(K * P * n))
+                mb_payload = mb_payload.at[flat_p].set(pay_s[p],
+                                                       mode="drop")
+            mb_payload = mb_payload.reshape(K, P, n)
         overflow = jnp.sum(ok_s & (pos >= K), dtype=jnp.int32)
         return mb_rel, mb_src, mb_payload, overflow
 
@@ -711,6 +830,137 @@ class JaxEngine(RunStatsMixin):
             # decision is made, so telemetry can never drift from it
             self._t_rung = jnp.asarray(rungs, jnp.int32)[idx]
         return jax.lax.switch(idx, [tail(A) for A in rungs])
+
+    def _route_firecompact(self, out, out_valid, now_vec, t, mb_rel,
+                           mb_src, mb_payload, free_rows, counts,
+                           node_ids, with_trace):
+        """The ``insert="pallas"`` adaptive routing stage
+        (pallas_insert.py): the fire-compaction kernel streams the raw
+        pre-masked outbox planes once and emits the compact fired
+        batch directly — no sender-compaction N-sort, no rung-width
+        gathers, no ``lax.switch`` ladder. The ordering sort
+        (destination, window offset, sender-major rank), link sampling
+        (with every fault mask point), and the SENT digest then run in
+        XLA at *compacted* width, exactly mirroring
+        ``_route_adaptive``'s branches, and ``_insert_sorted``
+        dispatches the sorted batch into the in-tile insertion kernel.
+        Bit-identical to the ladder path: same message set (the
+        default ``insert_cap`` is n·max_out, so nothing can drop),
+        same sort keys, same entropy, same counters — only lanes that
+        are masked out everywhere differ (tests/test_pallas_insert.py,
+        including under faults and the world axis)."""
+        sc = self.scenario
+        M, P = sc.max_out, sc.payload_width
+        n = self.comm.n_local
+        n_glob = self.comm.n_global
+        W = self.window
+        stage = self._pallas_stage
+        if self.telemetry != "off":
+            # the pallas path's "rung" is its static compacted batch
+            # width, sender-denominated (the ladder analog)
+            self._t_rung = jnp.int32(stage.A)
+        # XLA pre-mask — identical to _route_adaptive's head: validity
+        # + destination-range check folded into one signed plane
+        # (contract #6 corollary: out-of-range destinations counted,
+        # never silently dropped), partition cuts killed before
+        # compaction (sample-independent; the oracle drops the same
+        # set)
+        dst32 = out.dst.astype(jnp.int32)                       # [M, N]
+        dst_okf = (dst32 >= 0) & (dst32 < n_glob)
+        bad_dst_step = jnp.sum(out_valid & ~dst_okf, dtype=jnp.int32)
+        pdst = jnp.where(out_valid & dst_okf, dst32, -1)        # [M, N]
+        fault_cut = jnp.int32(0)
+        if self._faulted and self._ft.part_group.shape[0]:
+            from ...faults.apply import cut_mask
+            cutm = (pdst >= 0) & cut_mask(
+                self._ft, node_ids[None, :], pdst, now_vec[None, :])
+            fault_cut = jnp.sum(cutm, dtype=jnp.int32)
+            pdst = jnp.where(cutm, jnp.int32(-1), pdst)
+        woff_n = (now_vec - t).astype(jnp.int32)                # [N]
+
+        # the kernel: compact fired batch at static width S (sentinel
+        # dst = n beyond the fired width; capacity drops counted —
+        # zero by construction at the default insert_cap)
+        dst_f, woff_f, smrank, pay_f, route_drop_step = stage.compact(
+            pdst, woff_n, out.payload)
+        ok = dst_f < jnp.int32(n)
+
+        if self._faulted:
+            # sample BEFORE the routing sort (the down-window drop
+            # needs deliver times before insertion ranks exist) —
+            # _route_adaptive's branch_faulted, at compacted width
+            from ...faults.apply import down_mask
+            src_l = smrank // jnp.int32(M)
+            tmsg_l = t + woff_f.astype(jnp.int64)
+            flight, drel, bad_delay_step, short_step = \
+                self._sample_nodrop(src_l, dst_f, tmsg_l,
+                                    smrank % jnp.int32(M), woff_f, ok)
+            downm = ok & down_mask(self._ft, dst_f, tmsg_l + flight)
+            fault_down = jnp.sum(downm, dtype=jnp.int32)
+            ok2 = ok & ~downm
+            sent_count = jnp.sum(ok2, dtype=jnp.int32)
+            if with_trace:
+                dt_abs = tmsg_l + flight
+                sent_mix = mix32_jnp(SENT, src_l, dst_f,
+                                     _tlo(dt_abs), _thi(dt_abs),
+                                     pay_f[0])
+                sent_hash = _u32sum(jnp.where(ok2, sent_mix, 0))
+            else:
+                sent_hash = jnp.uint32(0)
+            sort_dst = jnp.where(ok2, dst_f, n)
+            if W > 1:
+                ops = jax.lax.sort(
+                    (sort_dst, woff_f, smrank, drel) + pay_f,
+                    dimension=0, num_keys=3)
+                sd, smrank_s, drel_s = ops[0], ops[2], ops[3]
+                pay_s = ops[4:]
+            else:
+                ops = jax.lax.sort(
+                    (sort_dst, smrank, drel) + pay_f,
+                    dimension=0, num_keys=2)
+                sd, smrank_s, drel_s = ops[0], ops[1], ops[2]
+                pay_s = ops[3:]
+            ok_s = sd < n
+            src_s = smrank_s // jnp.int32(M)
+            mrel, msrc, mpay, overflow_step = self._insert_sorted(
+                mb_rel, mb_src, mb_payload, sd, ok_s, drel_s,
+                src_s, pay_s, free_rows, counts)
+            return (mrel, msrc, mpay, overflow_step, bad_dst_step,
+                    bad_delay_step, short_step, route_drop_step,
+                    sent_count, sent_hash, fault_cut + fault_down)
+
+        sort_dst = jnp.where(ok, dst_f, n)
+        if W > 1:
+            ops = jax.lax.sort((sort_dst, woff_f, smrank) + pay_f,
+                               dimension=0, num_keys=3)
+            sd, woff_s, smrank_s = ops[0], ops[1], ops[2]
+            pay_s = ops[3:]
+        else:
+            ops = jax.lax.sort((sort_dst, smrank) + pay_f,
+                               dimension=0, num_keys=2)
+            sd, smrank_s = ops[0], ops[1]
+            woff_s = jnp.zeros_like(sd)
+            pay_s = ops[2:]
+        ok_s = sd < n
+        src_s = smrank_s // jnp.int32(M)
+        tmsg_s = t + woff_s.astype(jnp.int64)
+        flight_s, drel_s, bad_delay_step, short_step = \
+            self._sample_nodrop(src_s, sd, tmsg_s,
+                                smrank_s % jnp.int32(M), woff_s, ok_s)
+        mrel, msrc, mpay, overflow_step = self._insert_sorted(
+            mb_rel, mb_src, mb_payload, sd, ok_s, drel_s,
+            src_s, pay_s, free_rows, counts)
+        sent_count = jnp.sum(ok, dtype=jnp.int32)
+        if with_trace:
+            dt_abs = tmsg_s + flight_s
+            sent_mix = mix32_jnp(SENT, src_s, sd, _tlo(dt_abs),
+                                 _thi(dt_abs), pay_s[0])
+            sent_hash = _u32sum(jnp.where(ok_s, sent_mix, 0))
+        else:
+            sent_hash = jnp.uint32(0)
+        return (mrel, msrc, mpay, overflow_step, bad_dst_step,
+                bad_delay_step, short_step, route_drop_step,
+                sent_count, sent_hash)
 
     def _superstep(self, st: EngineState, with_trace: bool
                    ) -> Tuple[EngineState, Optional[_StepOut]]:
@@ -910,12 +1160,17 @@ class JaxEngine(RunStatsMixin):
         #    flatten order is free — no transpose of the [M, N]
         #    outbox). Each message is stamped with its sender's firing
         #    instant (== t for W == 1), which keys the link entropy.
-        adaptive = (self.route_cap is None
-                    and not self.link.can_drop
-                    and type(comm) is LocalComm
-                    and (W > 1 or M > 1))
+        adaptive = self._adaptive_regime()
         if adaptive:
-            res = self._route_adaptive(
+            # insert="pallas"/"interpret": fire-compaction replaces
+            # the sender-compaction sort + rung-gather ladder
+            # (pallas_insert.py) — result-identical by the insert
+            # exactness law, only the venue differs
+            route = self._route_adaptive \
+                if self._pallas_stage is None \
+                or not self._pallas_stage.adaptive \
+                else self._route_firecompact
+            res = route(
                 out, out_valid, now_vec, t, mb_rel, mb_src,
                 mb_payload, free_rows, counts, node_ids, with_trace)
             (mb_rel, mb_src, mb_payload, overflow_step, bad_dst_step,

@@ -82,26 +82,24 @@ from ...utils import jaxconfig  # noqa: F401
 
 import jax
 import jax.numpy as jnp
-from jax.experimental import pallas as pl
-from jax.experimental.pallas import tpu as pltpu
 
-from ...core.rng import _MSG_TAG, normal_f32, threefry2x32
+from ...core.rng import normal_f32, threefry2x32
 from ...core.scenario import Scenario
 from ...net.delays import (FixedDelay, LinkModel, LogNormalDelay,
                            Quantize, SeededHashUniform, UniformDelay)
 from ...trace.hashing import SENT, mix32_jnp
-from .common import I32MAX as _I32MAX
-from .common import group_rank, thi as _thi, tlo as _tlo, u32sum as _u32sum
+from .common import thi as _thi, tlo as _tlo, u32sum as _u32sum
 from .engine import JaxEngine
+# the kernel machinery now lives in pallas_insert.py (the insert=
+# knob's home, round 12) — these modules share ONE copy so the probed
+# Mosaic constraint inventory and the VMEM budget cannot drift apart.
+# Re-exported here because the sharded engines (and r6-era callers)
+# import them from this module.
+from .pallas_insert import (_LANES, _ROWS, _VMEM_BUDGET,  # noqa: F401
+                            _build_kernel, _fold_lanes, _fold_rows8,
+                            _fused_insert_call, _insertion_plan)
 
 __all__ = ["FusedSparseEngine"]
-
-_LANES = 1024
-_ROWS = 8          # rows per pipelined mailbox block (when NR % 8 == 0)
-#: VMEM budget the constructor guards against (resident batch + the
-#: four double-buffered block buffers), leaving headroom of a ~16 MB
-#: VMEM for the compiler's own temporaries
-_VMEM_BUDGET = 12 * 2**20
 
 
 # ----------------------------------------------------------------------
@@ -181,328 +179,6 @@ def _lower_link(link: LinkModel):
         "kernel (supported: FixedDelay / UniformDelay / "
         "SeededHashUniform / LogNormalDelay, optionally Quantize-"
         "wrapped); run the XLA JaxEngine instead")
-
-
-# ----------------------------------------------------------------------
-# kernel helpers: reductions as lane partials (no scalar reductions
-# lower in-kernel — fused_ring.py constraint inventory)
-# ----------------------------------------------------------------------
-
-def _fold_lanes(x):
-    """[R, 1024] int32 -> [R, 128] partial sums (unrolled adds)."""
-    R = x.shape[0]
-    x = x.reshape(R, _LANES // 128, 128)
-    acc = x[:, 0]
-    for j in range(1, _LANES // 128):
-        acc = acc + x[:, j]
-    return acc
-
-
-def _fold_rows8(x):
-    """[rows, 128] int32 -> [8, 128] partial sums. rows must be a
-    multiple of 8, or < 8 (zero-padded — axis-0 concat lowers, lane
-    axis does not)."""
-    rows = x.shape[0]
-    if rows < 8:
-        return jnp.concatenate(
-            [x, jnp.zeros((8 - rows, 128), jnp.int32)], axis=0)
-    acc = x[0:8]
-    for i in range(1, rows // 8):
-        acc = acc + x[8 * i:8 * i + 8]
-    return acc
-
-
-# ----------------------------------------------------------------------
-# shared scope guards + static shape plan (single-chip engine AND the
-# sharded insertion path — one copy, so the kernel's constraint
-# inventory and the VMEM budget cannot desynchronize between them)
-# ----------------------------------------------------------------------
-
-def _insertion_plan(sc: Scenario, n: int, S_raw: int, *, who: str,
-                    what_n: str = "n_nodes"):
-    """Check ``sc`` against the fused insertion kernel's constraint
-    inventory (commutative inbox, K <= 128 unrolled hole cumsum,
-    1024-lane mailbox planes), round the resident batch width up to
-    8-row tiling, and size the VMEM footprint against the budget.
-    Returns ``(S, R, G)`` — batch width, rows per block, block count.
-    Raises ``ValueError`` (never silently narrows scope)."""
-    if not sc.commutative_inbox:
-        raise ValueError(
-            f"{who} requires a commutative_inbox scenario (insertion "
-            "targets mailbox holes; an ordered inbox owes the "
-            "contract-#2 compaction sort — run the XLA engine)")
-    if sc.payload_width < 1:
-        raise ValueError("payload_width must be >= 1")
-    if sc.mailbox_cap > 128:
-        raise ValueError("mailbox_cap must be <= 128 (the kernel "
-                         "unrolls the hole-rank cumsum over K)")
-    if n % _LANES:
-        raise ValueError(
-            f"{what_n} must be a multiple of {_LANES} (mailbox "
-            "block lane shape)")
-    NR = n // _LANES
-    R = _ROWS if NR % _ROWS == 0 else 1
-    S = -(-S_raw // 1024) * 1024            # SR must be 8-row tiled
-    K, P = sc.mailbox_cap, sc.payload_width
-    NP = 2 + K + K * P + (K if sc.inbox_src else 0)
-    NPO = NP - 2
-    footprint = (3 + P) * S * 4 + 2 * (NP + NPO) * R * _LANES * 4
-    if footprint > _VMEM_BUDGET:
-        raise ValueError(
-            f"fused-sparse VMEM footprint {footprint} B exceeds the "
-            f"{_VMEM_BUDGET} B budget — lower the batch bound "
-            "(max_batch / bucket_cap) or mailbox_cap")
-    return S, R, NR // R
-
-
-# ----------------------------------------------------------------------
-# the kernel
-# ----------------------------------------------------------------------
-
-def _build_kernel(*, K, P, R, G, SR, n, M, W, inbox_src, mode,
-                  needs_key, s0, s1, delay_fn):
-    """Build the grid-free fused routing kernel for one static shape.
-
-    Refs: ``scal`` SMEM int32[4] = [t_lo, t_hi, 0, 0]; ``msgs`` VMEM
-    int32[3+P, SR, 128] — the resident sorted batch, planes
-    (dst | woff | smrank | payload_0..P-1) in ``mode="sample"`` or
-    (dst | drel | src | payload…) in ``mode="drel"`` (pre-sampled,
-    the sharded insertion path); ``st_ref`` ANY
-    int32[NP, N/1024, 1024] — stacked (start | cnt | mb_rel[K] |
-    mb_payload[K*P] | mb_src[K]?) planes; outputs: the post-insertion
-    mailbox planes (same layout minus start/cnt) and int32[3, 8, 128]
-    lane-partial counters (overflow, bad_delay, short_delay)."""
-    KP = K * P
-    NP = 2 + K + KP + (K if inbox_src else 0)
-    NPO = K + KP + (K if inbox_src else 0)
-
-    def kernel(scal, msgs_ref, st_ref, out_ref, cnt_ref):
-        MAXI = jnp.int32(_I32MAX)
-        m = msgs_ref[:]                                 # [3+P, SR, 128]
-        dstp = m[0]
-        valid = dstp < jnp.int32(n)
-        zero_part = jnp.zeros((SR, 128), jnp.int32)
-        if mode == "sample":
-            woffp, smrank = m[1], m[2]
-            srcp = smrank // jnp.int32(M)
-            slot = smrank - srcp * jnp.int32(M)
-            # send instant = t + woff as two uint32 words with an
-            # explicit carry (int64 does not lower in-kernel)
-            tl = scal[0].astype(jnp.uint32)
-            th = scal[1].astype(jnp.uint32)
-            woff_u = woffp.astype(jnp.uint32)
-            lo = tl + woff_u
-            carry = (lo < tl).astype(jnp.uint32)
-            hi = th + carry
-            key = None
-            if needs_key:
-                # msg_bits (core/rng.py) inlined: same chain, same bits
-                a0, a1 = threefry2x32(
-                    jnp.uint32(s0) ^ jnp.uint32(_MSG_TAG),
-                    jnp.uint32(s1), srcp, dstp)
-                b0, b1 = threefry2x32(a0, a1, lo, hi)
-                key = threefry2x32(b0, b1, slot, jnp.uint32(0))
-            delay = delay_fn(srcp, dstp, lo, hi, key)
-            flight = jnp.maximum(delay, jnp.uint32(1))  # contract #4
-            dsum = woff_u + flight
-            badm = valid & (dsum > jnp.uint32(_I32MAX - 1))
-            shortm = (valid & (flight < jnp.uint32(W))) if W > 1 \
-                else jnp.zeros((SR, 128), bool)
-            drelp = jnp.minimum(
-                dsum, jnp.uint32(_I32MAX - 1)).astype(jnp.int32)
-            bad8 = _fold_rows8(badm.astype(jnp.int32))
-            short8 = _fold_rows8(shortm.astype(jnp.int32))
-            srcp = srcp if inbox_src else None
-        else:
-            drelp, srcp = m[1], (m[2] if inbox_src else None)
-            bad8 = short8 = _fold_rows8(zero_part)
-        payps = [m[3 + p] for p in range(P)]
-
-        def block_compute(blk):
-            """Insert the resident batch into one [NP, R, L] mailbox
-            block: rank holes (unrolled K-cumsum), meet the r-th
-            message to each destination at its r-th hole via a gather
-            from the resident planes. Returns the output block and
-            the per-node overflow partial."""
-            start_b, cnt_b = blk[0], blk[1]
-            rel = blk[2:2 + K]
-            pay = blk[2 + K:2 + K + KP]
-            smb = blk[2 + K + KP:] if inbox_src else None
-            acc = jnp.zeros(rel[0].shape, jnp.int32)
-            o_rel, o_pay, o_src = [], [None] * KP, []
-            for k in range(K):
-                free_k = rel[k] >= MAXI
-                h_k = acc
-                acc = acc + free_k.astype(jnp.int32)
-                want = free_k & (h_k < cnt_b)
-                j = jnp.where(want, start_b + h_k, jnp.int32(0))
-                jr = j // jnp.int32(128)
-                jc = j - jr * jnp.int32(128)
-                o_rel.append(jnp.where(want, drelp[jr, jc], rel[k]))
-                for p in range(P):
-                    o_pay[k * P + p] = jnp.where(
-                        want, payps[p][jr, jc], pay[k * P + p])
-                if inbox_src:
-                    o_src.append(jnp.where(want, srcp[jr, jc], smb[k]))
-            # messages beyond a destination's hole count are dropped
-            # and counted — identical to _insert_sorted's ok & ~fits
-            ovf = jnp.maximum(cnt_b - acc, jnp.int32(0))
-            out = jnp.stack(o_rel + o_pay + o_src)
-            return out, _fold_lanes(ovf)
-
-        def body(in_buf0, in_buf1, out_buf0, out_buf1,
-                 in_sem0, in_sem1, out_sem0, out_sem1):
-            RW = jnp.int32(R)
-            in_bufs = (in_buf0, in_buf1)
-            out_bufs = (out_buf0, out_buf1)
-            in_sems = (in_sem0, in_sem1)
-            out_sems = (out_sem0, out_sem1)
-
-            def in_dma(slot, b):
-                return pltpu.make_async_copy(
-                    st_ref.at[:, pl.ds(b * RW, R), :],
-                    in_bufs[slot], in_sems[slot])
-
-            def out_dma(slot, b):
-                return pltpu.make_async_copy(
-                    out_bufs[slot],
-                    out_ref.at[:, pl.ds(b * RW, R), :],
-                    out_sems[slot])
-
-            in_dma(0, 0).start()
-            ONE = jnp.int32(1)
-            TWO = jnp.int32(2)
-            GG = jnp.int32(G)
-
-            def when_slot(slot, fn):
-                # dynamic buffer-slot indices emit 64-bit memref
-                # slices Mosaic rejects — unroll the two slots
-                @pl.when(slot == jnp.int32(0))
-                def _():
-                    fn(0)
-
-                @pl.when(slot == ONE)
-                def _():
-                    fn(1)
-
-            def loop(carry):
-                b, slot, ovf = carry
-
-                @pl.when(b + ONE < GG)
-                def _():
-                    when_slot(slot,
-                              lambda sl: in_dma(1 - sl, b + ONE).start())
-
-                when_slot(slot, lambda sl: in_dma(sl, b).wait())
-                blk = jnp.where(slot == ONE, in_buf1[:], in_buf0[:])
-                out, o = block_compute(blk)
-
-                @pl.when(b >= TWO)
-                def _():
-                    when_slot(slot, lambda sl: out_dma(sl, b - TWO).wait())
-
-                def put(sl):
-                    out_bufs[sl][:] = out
-                    out_dma(sl, b).start()
-                when_slot(slot, put)
-                return (b + ONE, ONE - slot, ovf + o)
-
-            carry = jax.lax.while_loop(
-                lambda c: c[0] < GG, loop,
-                (jnp.int32(0), jnp.int32(0),
-                 jnp.zeros((R, 128), jnp.int32)))
-
-            if G >= 2:
-                out_dma(G % 2, jnp.int32(G - 2)).wait()
-            out_dma((G - 1) % 2, jnp.int32(G - 1)).wait()
-            cnt_ref[:] = jnp.stack(
-                [_fold_rows8(carry[2]), bad8, short8])
-
-        pl.run_scoped(
-            body,
-            in_buf0=pltpu.VMEM((NP, R, _LANES), jnp.int32),
-            in_buf1=pltpu.VMEM((NP, R, _LANES), jnp.int32),
-            out_buf0=pltpu.VMEM((NPO, R, _LANES), jnp.int32),
-            out_buf1=pltpu.VMEM((NPO, R, _LANES), jnp.int32),
-            in_sem0=pltpu.SemaphoreType.DMA(()),
-            in_sem1=pltpu.SemaphoreType.DMA(()),
-            out_sem0=pltpu.SemaphoreType.DMA(()),
-            out_sem1=pltpu.SemaphoreType.DMA(()),
-        )
-
-    return kernel
-
-
-# ----------------------------------------------------------------------
-# the kernel invocation shared by the single-chip engine and the
-# sharded insertion path (sharded.py ShardedFusedSparseEngine)
-# ----------------------------------------------------------------------
-
-def _fused_insert_call(kernel, S, n, K, P, inbox_src, scal, sd, a1, a2,
-                       pay_s, mb_rel, mb_src, mb_payload):
-    """Stack the sorted batch + per-node bucket planes and run the
-    fused kernel once. ``sd`` is the sorted destination row (sentinel
-    ``n`` = invalid); ``(a1, a2)`` are the mode's second/third resident
-    planes — (woff, smrank) for in-kernel sampling, (drel, src) for
-    pre-sampled insertion. Returns the post-insertion mailbox arrays
-    plus the [3, 8, 128] counter partials."""
-    SA = sd.shape[0]
-    L = _LANES
-    NR = n // L
-
-    # per-destination bucket boundaries: two S-sized scatters into [N]
-    # planes (S = the compacted batch width — the sparse regime's
-    # cheap side); the kernel meets rank r at hole r via start + r
-    rank = group_rank(sd)
-    validm = sd < n
-    iota = jnp.arange(SA, dtype=jnp.int32)
-    start = jnp.zeros(n, jnp.int32).at[
-        jnp.where(validm & (rank == 0), sd, n)].set(iota, mode="drop")
-    nxt = jnp.concatenate([sd[1:], jnp.full((1,), n, sd.dtype)])
-    cnt = jnp.zeros(n, jnp.int32).at[
-        jnp.where(validm & (sd != nxt), sd, n)].set(
-            rank + 1, mode="drop")
-
-    pad = S - SA
-
-    def padded(x, fill):
-        if not pad:
-            return x
-        return jnp.concatenate([x, jnp.full((pad,), fill, x.dtype)])
-
-    SR = S // 128
-    msgs = jnp.stack(
-        [padded(sd, n).reshape(SR, 128),
-         padded(a1, 0).reshape(SR, 128),
-         padded(a2, 0).reshape(SR, 128)]
-        + [padded(p, 0).reshape(SR, 128) for p in pay_s])
-    st_planes = jnp.concatenate(
-        [start.reshape(1, NR, L), cnt.reshape(1, NR, L),
-         mb_rel.reshape(K, NR, L),
-         mb_payload.reshape(K * P, NR, L)]
-        + ([mb_src.reshape(K, NR, L)] if inbox_src else []),
-        axis=0)
-
-    NPO = K + K * P + (K if inbox_src else 0)
-    out_planes, cnts = pl.pallas_call(
-        kernel,
-        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM),
-                  pl.BlockSpec(memory_space=pltpu.VMEM),
-                  pl.BlockSpec(memory_space=pltpu.ANY)],
-        out_specs=[pl.BlockSpec(memory_space=pltpu.ANY),
-                   pl.BlockSpec(memory_space=pltpu.VMEM)],
-        out_shape=[
-            jax.ShapeDtypeStruct((NPO, NR, L), jnp.int32),
-            jax.ShapeDtypeStruct((3, 8, 128), jnp.int32)],
-        # non-TPU backends run the pallas interpreter — identical
-        # DMA/loop semantics, which is what the exactness tests pin
-        interpret=jax.default_backend() != "tpu",
-    )(scal, msgs, st_planes)
-    mrel = out_planes[:K].reshape(K, n)
-    mpay = out_planes[K:K + K * P].reshape(K, P, n)
-    msrc = out_planes[K + K * P:].reshape(K, n) if inbox_src \
-        else mb_src
-    return mrel, msrc, mpay, cnts
 
 
 # ----------------------------------------------------------------------

@@ -282,7 +282,9 @@ class ShardedFusedSparseEngine(ShardedEngine):
         super().__init__(scenario, link, mesh, axis=axis, seed=seed,
                          bucket_cap=bucket_cap, window=window,
                          route_cap=None, lint=lint, telemetry=telemetry)
-        from .fused_sparse import _build_kernel, _insertion_plan
+        # the kernel machinery's home since round 12 (pallas_insert.py;
+        # fused_sparse re-exports for older callers)
+        from .pallas_insert import _build_kernel, _insertion_plan
         sc = scenario
         nl = self.comm.n_local
         # post-exchange batch width: one bucket per peer shard
@@ -299,7 +301,7 @@ class ShardedFusedSparseEngine(ShardedEngine):
 
     def _insert_sorted(self, mb_rel, mb_src, mb_payload, sd, ok_s,
                        drel_s, src_s, pay_s, free_rows, counts):
-        from .fused_sparse import _fused_insert_call
+        from .pallas_insert import _fused_insert_call
         sc = self.scenario
         mrel, msrc, mpay, cnts = _fused_insert_call(
             self._ins_kernel, self._S2, self.comm.n_local,
