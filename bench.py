@@ -723,6 +723,182 @@ def bench_sweep_hetero(n, steps):
             delivered / dt, extra)
 
 
+def _bursty_gossip(n):
+    """Density-varying workload for the dispatch-controller bench
+    (dispatch/, docs/dispatch.md): burst-wave gossip with a long think
+    incubation — quiet phases between fan-out storm generations — over
+    an 8 ms-floor link, plus a mid-run degradation window that
+    undercuts the floor to 2 ms. The scenario where no single static
+    window can win: a static engine must validate against the
+    schedule-wide degraded floor (2 ms) for the WHOLE run, while the
+    controller runs the 8 ms bound and the per-superstep device clamp
+    (faults/apply.window_floor) narrows exactly the supersteps the
+    degradation window overlaps."""
+    from timewarp_tpu.faults import FaultSchedule, LinkWindow
+    from timewarp_tpu.models.gossip import gossip, gossip_links
+    from timewarp_tpu.net.delays import Quantize
+    sc = gossip(n, fanout=8, think_us=40_000, burst=True,
+                end_us=5_000_000, mailbox_cap=16)
+    link = Quantize(gossip_links(median_us=20_000, sigma=0.6,
+                                 floor_us=8_000), 1_000)
+    faults = FaultSchedule((LinkWindow(None, None, 100_000, 200_000,
+                                       scale=0.25),))
+    return sc, link, faults
+
+
+def bench_gossip_100k_auto(n, steps):
+    """The bursty gossip wave under the online dispatch controller
+    (run_controlled: telemetry-driven window/rung/chunk adaptation,
+    zero retrace). Gated in-bench by the REPLAY LAW — a second engine
+    re-executing the emitted decision trace must reproduce the
+    digests bit-for-bit — and by a deterministic structural win:
+    fewer supersteps than the best static window (which the
+    degradation window forces down to the schedule-wide floor).
+    Reports ``controller_gain_frac`` vs the best single static
+    config; the wall-clock half is asserted > 0 on full rounds only
+    (smoke-scale CPU noise dwarfs it — the superstep win asserts
+    everywhere)."""
+    import numpy as np
+    from timewarp_tpu.dispatch import DecisionTrace, DispatchController
+    from timewarp_tpu.interp.jax_engine.engine import JaxEngine
+    from timewarp_tpu.sweep.spec import DIGEST_ZERO, chain_digest
+    from timewarp_tpu.trace.events import assert_states_equal
+
+    n = n or 100_000
+    steps = steps or (1 << 14)
+    sc, link, faults = _bursty_gossip(n)
+    eng = JaxEngine(sc, link, window="auto", faults=faults,
+                    telemetry="counters", lint="off",
+                    controller=DispatchController(chunk=16,
+                                                  chunk_max=64))
+    eng.run_controlled(steps)  # warmup: compiles + the decision trace
+    decs = eng.last_run_decisions
+    t0 = time.perf_counter()
+    fin, tr = eng.run_controlled(steps)  # decisions replayed from made
+    wall_auto = time.perf_counter() - t0
+    delivered = int(np.asarray(jax.device_get(fin.delivered)).sum())
+    # gate 1: the replay law — a fresh engine re-executing the
+    # decision trace must match digests bit-for-bit
+    rep = JaxEngine(sc, link, window="auto", faults=faults, lint="off",
+                    controller=DispatchController(
+                        mode="replay", replay=DecisionTrace.of(decs)))
+    rfin, rtr = rep.run_controlled(steps)
+    assert chain_digest(DIGEST_ZERO, tr) == chain_digest(DIGEST_ZERO,
+                                                         rtr), \
+        "controller run's digests diverge from its decision-trace " \
+        "replay (the replay law)"
+    assert_states_equal(fin, rfin, "controller replay law (bench)")
+    _assert_wave_done(eng, fin, n)
+    # best static config: the widest legal static window (the
+    # schedule-wide degraded floor — construction refuses anything
+    # wider under this schedule) and the classic window=1 engine.
+    # Each gets its BEST driver — run_quiet's while_loop exits at
+    # quiescence with no trace/telemetry work compiled in — so the
+    # controller's chunked traced driver competes against the
+    # strongest static baseline, not a strawman
+    best_rate, best_name, static_steps = 0.0, "", None
+    for name, w in (("static-auto", "auto"), ("window-1", 1)):
+        st_eng = JaxEngine(sc, link, window=w, faults=faults,
+                           lint="off")
+        st_eng.run_quiet(steps)  # warmup compile
+        t0 = time.perf_counter()
+        sfin = st_eng.run_quiet(steps)
+        dt = time.perf_counter() - t0
+        sdel = int(np.asarray(jax.device_get(sfin.delivered)).sum())
+        assert sdel == delivered, \
+            f"static {name} delivered {sdel} != controller {delivered}"
+        if sdel / dt > best_rate:
+            best_rate, best_name = sdel / dt, name
+        if name == "static-auto":
+            static_steps = int(np.asarray(
+                jax.device_get(sfin.steps)).max())
+    # gate 2: deterministic structural win — the controller's wide
+    # windows outside the degradation slice coalesce more instants
+    assert len(tr) < static_steps, \
+        f"controller ran {len(tr)} supersteps vs static-auto's " \
+        f"{static_steps} — the window adaptation never bit"
+    gain = delivered / wall_auto / best_rate - 1.0
+    if not _SMOKE:
+        assert gain > 0, \
+            f"controller_gain_frac={gain:.4f} <= 0 vs {best_name}"
+    extra = {"controller_gain_frac": round(gain, 4),
+             "best_static": best_name,
+             "supersteps_auto": len(tr),
+             "supersteps_static": static_steps,
+             "decisions": len(decs),
+             "decision_windows": sorted({d.window_us for d in decs})}
+    return (f"bursty gossip wave under the dispatch controller "
+            f"(auto window/rung/chunk) delivered-messages/sec/chip "
+            f"@{n} nodes", delivered / wall_auto, extra)
+
+
+def bench_sweep_hetero_auto(n, steps):
+    """The heterogeneous sweep with the windowed gossip worlds under
+    ``controller: auto`` (sweep/: per-bucket decisions journaled
+    before each chunk). Gated by the controller form of the sweep
+    survival law: every streamed result must be bit-identical to the
+    solo run REPLAYING the bucket's journaled decision chain — plus
+    the plain law for the controller-off worlds."""
+    import shutil
+    import tempfile
+
+    from timewarp_tpu.sweep import SweepPack, SweepService, solo_result
+
+    n = n or 4096
+    steps = steps or 2000
+    ring = {"nodes": n, "n_tokens": max(4, n // 64), "think_us": 2000,
+            "end_us": 1 << 40, "mailbox_cap": 8}
+    gossip = {"nodes": n, "fanout": 4, "burst": True,
+              "end_us": 400_000, "mailbox_cap": 16, "think_us": 700}
+    pack = SweepPack.from_json([
+        {"id": "ring-s0", "scenario": "token-ring", "params": ring,
+         "link": "uniform:1000:5000", "seed": 0, "budget": steps},
+        {"id": "gos-a0", "scenario": "gossip", "params": gossip,
+         "link": "quantize:1000:uniform:3000:9000", "seed": 3,
+         "window": "auto", "budget": steps, "controller": "auto"},
+        {"id": "gos-a1", "scenario": "gossip", "params": gossip,
+         "link": "quantize:1000:uniform:3000:9000", "seed": 4,
+         "window": "auto", "budget": max(steps // 2, 8),
+         "controller": "auto"},
+        {"id": "gos-a2", "scenario": "gossip", "params": gossip,
+         "link": "quantize:1000:uniform:4000:8000", "seed": 5,
+         "window": "auto", "budget": steps, "controller": "auto"},
+    ])
+    d = tempfile.mkdtemp(prefix="tw_sweep_auto_")
+    try:
+        t0 = time.perf_counter()
+        svc = SweepService(pack, d, chunk=max(16, steps // 16),
+                           lint="off", inject="fail:2")
+        report = svc.run()
+        dt = time.perf_counter() - t0
+        assert report.ok, f"sweep failed: {report.to_json()}"
+        assert report.retries >= 1, \
+            "the injected transient failure never exercised the retry"
+        scan = svc.journal.scan()
+        n_dec = sum(len(v) for v in scan.decisions.values())
+        assert n_dec > 0, "controller bucket journaled no decisions"
+        for rid, res in report.done.items():
+            cfg = pack.by_id(rid)
+            decs = svc.decisions_for_world(rid, scan) \
+                if cfg.controller == "auto" else None
+            want = solo_result(cfg, lint="off", decisions=decs)
+            assert want == res, (
+                f"controller sweep survival law violated for {rid}:\n"
+                f"  solo:     {want}\n  streamed: {res}")
+        delivered = sum(r["delivered"] for r in report.done.values())
+        extra = {"worlds": report.total,
+                 "controller_worlds": sum(
+                     1 for c in pack.configs if c.controller == "auto"),
+                 "decisions_journaled": n_dec,
+                 "retries": report.retries}
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+    return (f"heterogeneous sweep service with per-bucket dispatch "
+            f"controller (decisions journaled + replay-verified) "
+            f"aggregate delivered-messages/sec @{n} nodes",
+            delivered / dt, extra)
+
+
 def bench_praos_1m_b4(n, steps):
     """Praos as a 4-world fleet sweeping BOTH seed and link model per
     world (lognormal median 18/20/22/24 ms — a Monte-Carlo link study
@@ -840,12 +1016,14 @@ CONFIGS = {
     "gossip_100k_insert": bench_gossip_100k_insert,
     "gossip_100k_b8": bench_gossip_100k_b8,
     "gossip_100k_chaos": bench_gossip_100k_chaos,
+    "gossip_100k_auto": bench_gossip_100k_auto,
     "gossip_steady_1m": bench_gossip_steady_1m,
     "praos_1m": bench_praos_1m,
     "praos_1m_fused": bench_praos_1m_fused,
     "praos_1m_insert": bench_praos_1m_insert,
     "praos_1m_b4": bench_praos_1m_b4,
     "sweep_hetero": bench_sweep_hetero,
+    "sweep_hetero_auto": bench_sweep_hetero_auto,
 }
 
 #: --smoke shapes: every config tiny enough for a CPU CI runner, all
@@ -860,12 +1038,14 @@ SMOKE = {
     "gossip_100k_insert": (2048, 1 << 14),
     "gossip_100k_b8": (1024, 1 << 14),
     "gossip_100k_chaos": (1024, 1 << 14),
+    "gossip_100k_auto": (1024, 1 << 14),
     "gossip_steady_1m": (4096, 16),
     "praos_1m": (2048, 24),
     "praos_1m_fused": (2048, 24),
     "praos_1m_insert": (2048, 24),
     "praos_1m_b4": (1024, 24),
     "sweep_hetero": (256, 96),
+    "sweep_hetero_auto": (256, 96),
 }
 
 

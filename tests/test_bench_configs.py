@@ -18,14 +18,16 @@ def test_bench_config_runs(cfg):
          "gossip_100k": 512, "gossip_100k_fused": 2048,
          "gossip_100k_insert": 2048,
          "gossip_100k_b8": 512, "gossip_100k_chaos": 512,
+         "gossip_100k_auto": 512,
          "gossip_steady_1m": 512,
          "praos_1m": 512, "praos_1m_fused": 2048,
          "praos_1m_insert": 2048,
-         "praos_1m_b4": 512, "sweep_hetero": 256}[cfg]
+         "praos_1m_b4": 512, "sweep_hetero": 256,
+         "sweep_hetero_auto": 256}[cfg]
     # the gossip waves run to quiescence and assert they got there;
-    # the sweep-service config takes per-world budgets, not a window
+    # the sweep-service configs take per-world budgets, not a window
     steps = 20_000 if cfg.startswith("gossip_100k") else \
-        96 if cfg == "sweep_hetero" else 48
+        96 if cfg.startswith("sweep_hetero") else 48
     metric, rate, extra = bench._run_config(cfg, n, steps)
     assert rate > 0
     assert str(n) in metric

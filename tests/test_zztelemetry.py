@@ -325,11 +325,11 @@ def test_metrics_validation_is_loud(tmp_path):
     from timewarp_tpu.obs import (MetricsRegistry, validate_line,
                                   validate_metrics_file)
     with pytest.raises(ValueError, match="unknown metrics kind"):
-        validate_line({"schema": 1, "kind": "nope"})
+        validate_line({"schema": 2, "kind": "nope"})
     with pytest.raises(ValueError, match="schema"):
         validate_line({"schema": 99, "kind": "event", "name": "x"})
     with pytest.raises(ValueError, match="wall_s"):
-        validate_line({"schema": 1, "kind": "span", "name": "s",
+        validate_line({"schema": 2, "kind": "span", "name": "s",
                        "wall_s": "fast"})
     # emit refuses to write an invalid line at the source
     reg = MetricsRegistry()
@@ -337,8 +337,8 @@ def test_metrics_validation_is_loud(tmp_path):
         reg.emit("span", name="missing wall_s")
     # file validation names file and line
     p = tmp_path / "bad.jsonl"
-    p.write_text('{"schema": 1, "kind": "event", "name": "ok"}\n'
-                 '{"schema": 1, "kind": "mystery"}\n')
+    p.write_text('{"schema": 2, "kind": "event", "name": "ok"}\n'
+                 '{"schema": 2, "kind": "mystery"}\n')
     with pytest.raises(ValueError, match=r"bad\.jsonl:2"):
         validate_metrics_file(str(p))
 

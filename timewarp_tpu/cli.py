@@ -172,10 +172,40 @@ def build_faults(args):
     return parse_faults(args.faults)
 
 
+#: engines the dispatch controller drives (dispatch/,
+#: docs/dispatch.md) — the chunk-capable jitted engines
+CONTROLLER_ENGINES = ("general", "edge", "fused-sparse",
+                      "sharded-batched")
+
+
+def build_controller(args):
+    """The dispatch controller from --controller, or None."""
+    spec = getattr(args, "controller", None)
+    if spec in (None, "off"):
+        return None
+    from .dispatch import parse_controller
+    ctrl = parse_controller(spec)
+    if ctrl is not None and ctrl.mode == "auto" \
+            and getattr(args, "telemetry", "off") == "off":
+        raise SystemExit(
+            "--controller auto consumes per-chunk telemetry "
+            "(engine.last_run_telemetry); pass --telemetry "
+            "counters|full (replay:<trace> alone runs with "
+            "telemetry off)")
+    return ctrl
+
+
 def build_engine(args, sc, link):
     batch = build_batch(args)
     faults = build_faults(args)
     telemetry = getattr(args, "telemetry", "off")
+    controller = build_controller(args)
+    if controller is not None \
+            and args.engine not in CONTROLLER_ENGINES:
+        raise SystemExit(
+            f"--controller drives the chunk-capable jitted engines "
+            f"({', '.join(CONTROLLER_ENGINES)}); {args.engine} has "
+            "no chunked scan driver to adapt (docs/dispatch.md)")
     if telemetry != "off" and args.engine == "oracle":
         raise SystemExit(
             "--telemetry threads on-device counter planes through the "
@@ -257,7 +287,8 @@ def build_engine(args, sc, link):
                          lint=args.lint, batch=batch, faults=faults,
                          telemetry=telemetry,
                          insert=getattr(args, "insert", None),
-                         insert_cap=getattr(args, "insert_cap", None))
+                         insert_cap=getattr(args, "insert_cap", None),
+                         controller=controller)
     if args.engine == "sharded-batched":
         from .interp.jax_engine.sharded import (ShardedBatchedEngine,
                                                 make_mesh)
@@ -265,7 +296,7 @@ def build_engine(args, sc, link):
             sc, link, make_mesh(args.devices, axis="worlds"),
             batch=batch, seed=args.seed, window=args.window,
             route_cap=args.route_cap, lint=args.lint, faults=faults,
-            telemetry=telemetry)
+            telemetry=telemetry, controller=controller)
     if args.engine == "fused-sparse":
         from .interp.jax_engine.fused_sparse import FusedSparseEngine
         kw = {} if args.max_batch is None else {
@@ -274,12 +305,12 @@ def build_engine(args, sc, link):
                                  window=args.window,
                                  record_events=args.record_events,
                                  lint=args.lint, telemetry=telemetry,
-                                 **kw)
+                                 controller=controller, **kw)
     if args.engine == "edge":
         from .interp.jax_engine.edge_engine import EdgeEngine
         return EdgeEngine(sc, link, seed=args.seed, cap=args.edge_cap,
                           lint=args.lint, faults=faults,
-                          telemetry=telemetry)
+                          telemetry=telemetry, controller=controller)
     if args.engine in ("sharded", "sharded-edge", "sharded-fused"):
         from .interp.jax_engine.sharded import (
             ShardedEdgeEngine, ShardedEngine,
@@ -550,6 +581,18 @@ def main(argv=None) -> int:
                         "'error' refuses to run a scenario with "
                         "error-severity findings, 'off' skips the "
                         "checks entirely")
+    p.add_argument("--controller", default="off",
+                   help="online adaptive dispatch (dispatch/, docs/"
+                        "dispatch.md): 'auto' adapts window/rung/"
+                        "chunk between jitted chunks from telemetry "
+                        "(needs --telemetry counters|full) and "
+                        "records a decision trace; 'replay:TRACE' "
+                        "re-applies a recorded trace bit-for-bit; "
+                        "'off' (default) static dispatch")
+    p.add_argument("--decisions-out", default=None,
+                   help="write the controller's decision trace to "
+                        "this JSONL file (needs --controller; the "
+                        "file replays via --controller replay:FILE)")
     p.add_argument("--telemetry", default="off",
                    choices=["off", "counters", "full"],
                    help="on-device telemetry (obs/, docs/"
@@ -575,6 +618,19 @@ def main(argv=None) -> int:
         raise SystemExit(
             "--metrics-out/--trace-out need --telemetry counters|full "
             "(off-mode engines record nothing, by contract)")
+    if args.decisions_out and args.controller == "off":
+        raise SystemExit("--decisions-out needs --controller "
+                         "auto|replay:* (static runs decide nothing)")
+    if args.controller != "off" and args.resume:
+        raise SystemExit(
+            "--controller and --resume cannot combine: decision "
+            "traces index chunks from the run start — checkpointed "
+            "controller runs are the sweep service's business "
+            "(timewarp-tpu sweep, docs/dispatch.md)")
+    if args.controller != "off" and args.engine == "oracle":
+        raise SystemExit(
+            "--controller drives the jitted chunked engines; the "
+            "host oracle has no compiled chunks to adapt")
 
     from .utils.logconfig import load_log_config
     load_log_config(args.log_config)
@@ -625,9 +681,24 @@ def main(argv=None) -> int:
                 # different seed would silently diverge from both runs
                 args.seed = ck_meta["seed"]
                 engine = build_engine(args, sc, link)
+        if args.metrics_out:
+            # attach BEFORE the run (the sweep service's pattern):
+            # chunked drivers (run_controlled) then flush every
+            # chunk's `supersteps` lines and the controller's
+            # `decision` lines as they happen — a post-run export
+            # would see only the final chunk
+            from .obs import MetricsRegistry
+            engine.metrics_label = f"{sc.name}/{args.engine}"
+            engine.metrics = MetricsRegistry(
+                path=args.metrics_out,
+                run=engine.metrics_label)
         from .obs.profiler import profile_session
         with profile_session(args.jax_profile):
-            final, trace = engine.run(args.steps, state=state)
+            if engine.controller is not None:
+                final, trace = engine.run_controlled(args.steps,
+                                                     state=state)
+            else:
+                final, trace = engine.run(args.steps, state=state)
         if args.save:
             from .utils.checkpoint import save_state
             meta = {"scenario": sc.name, "seed": args.seed}
@@ -702,6 +773,18 @@ def main(argv=None) -> int:
                    **final_info}
     if args.telemetry != "off":
         summary.update(_export_telemetry(args, sc, engine, trace))
+    if getattr(engine, "controller", None) is not None:
+        decs = engine.last_run_decisions or []
+        summary["controller"] = {
+            "mode": engine.controller.mode,
+            "decisions": len(decs),
+            "windows": sorted({d.window_us for d in decs}),
+            "chunk_lens": sorted({d.chunk_len for d in decs}),
+        }
+        if args.decisions_out:
+            from .dispatch import DecisionTrace
+            DecisionTrace.of(decs).save(args.decisions_out)
+            summary["controller"]["out"] = args.decisions_out
     print(json.dumps(summary))
     return 0
 
@@ -712,7 +795,7 @@ def _export_telemetry(args, sc, engine, trace) -> dict:
     JSONL, build the Perfetto trace, and return the summary-line
     fields. The run itself is already over — nothing here can touch
     the emulation."""
-    from .obs import MetricsRegistry, TraceBuilder
+    from .obs import TraceBuilder
     label = f"{sc.name}/{args.engine}"
     stats = engine.last_run_stats
     frames = engine.last_run_telemetry
@@ -722,9 +805,10 @@ def _export_telemetry(args, sc, engine, trace) -> dict:
                                                 4),
                           "compiles": stats["compiles"]}}
     if args.metrics_out:
-        reg = MetricsRegistry(path=args.metrics_out, run=label)
-        if frames is not None:
-            reg.superstep_chunk(label, frames)
+        # the registry was attached before the run (main()): the
+        # engine already chunk-flushed its `supersteps` (and any
+        # `decision`) lines — only the run-level summary is owed here
+        reg = engine.metrics
         reg.run_summary(label, stats)
         reg.close()
         info["metrics"] = args.metrics_out

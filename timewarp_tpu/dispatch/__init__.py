@@ -1,0 +1,17 @@
+"""Online adaptive dispatch (docs/dispatch.md): a host-side controller
+that auto-tunes the engines' dispatch knobs — superstep window width,
+adaptive-routing rung pinning, scan chunk length — between jitted
+chunks, from the telemetry the previous chunk streamed (obs/), with
+**no retrace in the hot loop** and a recorded decision trace whose
+replay is bit-identical (the replay law)."""
+
+from .controller import (CONTROLLER_GRAMMAR, DispatchController,
+                         parse_controller)
+from .trace import (DISPATCH_SCHEMA, Decision, DecisionTrace,
+                    DispatchTraceError)
+
+__all__ = [
+    "CONTROLLER_GRAMMAR", "DISPATCH_SCHEMA", "Decision",
+    "DecisionTrace", "DispatchController", "DispatchTraceError",
+    "parse_controller",
+]

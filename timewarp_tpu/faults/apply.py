@@ -27,6 +27,7 @@ from ..core.scenario import NEVER
 __all__ = [
     "defer_next", "restart_fire", "consume_restarts",
     "cut_mask", "down_mask", "degrade", "skewed_step",
+    "window_floor",
 ]
 
 
@@ -146,6 +147,44 @@ def degrade(ft, delay, src, dst, t_send):
             aff, (delay * ft.link_num[i]) // ft.link_den[i]
             + ft.link_add[i], delay)
     return delay.reshape(shape)
+
+
+def window_floor(ft, t, w_req, base_floor: int):
+    """Effective exact superstep window at instant ``t`` for a
+    *requested* width ``w_req`` (traced int64 scalar): the degraded
+    delay floor over sends in ``[t, t + w_req)``, clamped to
+    ``[1, w_req]``. The device-side half of the dynamic-window
+    contract (engine.py): a degradation window that undercuts the
+    link's declared floor mid-run narrows the superstep window for
+    exactly the supersteps it overlaps, instead of forcing the whole
+    run onto the conservative schedule-wide floor
+    (``FaultSchedule.min_delay_floor``).
+
+    ``base_floor`` is a *host int* lower bound on every world's
+    undegraded delay (the engine's controller window bound) — a
+    per-world traced floor would not lower under the link-param sweep
+    (``min_delay_us`` of a rebound link may do host arithmetic).
+    Same greedy fold as the host ``min_delay_floor`` (transforms are
+    monotone, so ``x <- min(x, T_i(x))`` in declaration order realizes
+    the minimum over every row subset), restricted to rows whose
+    window overlaps ``[t, t + w_req)`` — restricting to the *requested*
+    (not effective) span only admits extra rows, so the clamp is
+    conservative-safe. Inert pad rows (``t_end <= t_start``) never
+    match. Deterministic given ``(tables, t, w_req)``, which is what
+    keeps controller replay bit-exact."""
+    f = jnp.int64(base_floor)
+    L = ft.link_start.shape[0]
+    if L == 0:
+        return jnp.clip(jnp.asarray(w_req, jnp.int64), jnp.int64(1), f)
+    for i in range(L):
+        live = (ft.link_end[i] > ft.link_start[i]) \
+            & (ft.link_start[i] < t + w_req) & (ft.link_end[i] > t)
+        fi = jnp.maximum(
+            jnp.int64(1),
+            (f * ft.link_num[i]) // ft.link_den[i] + ft.link_add[i])
+        f = jnp.where(live, jnp.minimum(f, fi), f)
+    return jnp.clip(jnp.asarray(w_req, jnp.int64), jnp.int64(1),
+                    jnp.maximum(f, jnp.int64(1)))
 
 
 def skewed_step(step, skew):

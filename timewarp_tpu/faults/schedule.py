@@ -280,6 +280,25 @@ class FaultSchedule:
                     1, (floor * lw._num) // lw._den + lw.extra_us))
         return max(1, floor)
 
+    def min_delay_floor_in(self, link_floor: int, t_lo: int,
+                           t_hi: int) -> int:
+        """:meth:`min_delay_floor` restricted to degradation rows whose
+        window overlaps ``[t_lo, t_hi)`` — the *per-window* link floor
+        the online dispatch controller consumes (dispatch/): outside
+        every degradation window the bound is the link's own floor, so
+        a shrink window that undercuts the declared floor only narrows
+        the supersteps it actually covers. Host mirror of the device
+        clamp ``faults.apply.window_floor`` (same greedy fold, same
+        overlap rule), used for *policy* only — exactness never
+        depends on this query."""
+        floor = int(link_floor)
+        for lw in self.link_windows:
+            if lw.t_end > lw.t_start and lw.t_start < t_hi \
+                    and lw.t_end > t_lo:
+                floor = min(floor, max(
+                    1, (floor * lw._num) // lw._den + lw.extra_us))
+        return max(1, floor)
+
     def padded(self, crashes: int, parts: int, links: int
                ) -> "FaultSchedule":
         """This schedule with table shapes grown to the given row
@@ -413,6 +432,15 @@ class FaultFleet:
 
     def min_delay_floor(self, link_floor: int) -> int:
         return min(s.min_delay_floor(link_floor)
+                   for s in self.schedules)
+
+    def min_delay_floor_in(self, link_floor: int, t_lo: int,
+                           t_hi: int) -> int:
+        """Fleet-wide per-window floor: the min over every world's
+        (the controller makes one fleet decision per chunk, so the
+        bound must hold in every world — the recorded ``min`` slack
+        aggregation's twin for the floor side)."""
+        return min(s.min_delay_floor_in(link_floor, t_lo, t_hi)
                    for s in self.schedules)
 
     def tables(self, n_nodes: int) -> FaultTables:

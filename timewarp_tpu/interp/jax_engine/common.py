@@ -17,7 +17,27 @@ from ...ops.numeric import I32MAX, group_rank, thi, tlo, u32sum
 
 __all__ = ["LocalComm", "StepOut", "I32MAX", "group_rank", "u32sum",
            "tlo", "thi", "padded_scan", "scan_pad",
-           "init_states_wake", "RunStatsMixin"]
+           "init_states_wake", "RunStatsMixin", "DynDispatch"]
+
+
+class DynDispatch(NamedTuple):
+    """The online-dispatch controller's per-chunk knob values
+    (dispatch/), threaded into the traced scan drivers as ORDINARY
+    TRACED OPERANDS — never compile-time constants — so a controller
+    adapting them between chunks re-invokes the same executable with
+    new scalars (zero recompiles by construction; the pow2 scan pad
+    stays the drivers' only static input).
+
+    ``window`` — requested superstep window width, int64 µs (clamped
+    on-device to ``[1, engine.window]`` and, under a fault schedule,
+    to the per-superstep degraded link floor — faults/apply.py
+    ``window_floor``). ``rung_pin`` — a *floor* on the adaptive
+    routing ladder's selected rung index, int32 (-1 = unpinned; the
+    effective index is ``max(computed, pin)``, so a pin can only
+    select a wider — always result-identical — rung, never drop a
+    message)."""
+    window: Any     # int64[] requested window µs
+    rung_pin: Any   # int32[] ladder index floor, -1 = unpinned
 
 
 def init_states_wake(scenario):
@@ -181,5 +201,25 @@ class RunStatsMixin:
             "supersteps": int(d.sum()),
             "wall_seconds": time.perf_counter() - t0,
             "compiles": self._driver_compiles() - c0,
+        }
+        return self.last_run_stats
+
+    def _stats_merge(self, chunks) -> dict:
+        """Fold per-chunk ``last_run_stats`` dicts into one run-level
+        record for the chunked drivers (``run_stream``,
+        ``run_controlled``). Before this existed, each chunk's
+        ``run()`` overwrote ``last_run_stats``, so a chunked run
+        reported only its FINAL chunk — every earlier chunk's compile
+        (where the real compiles happen: the first use of each pow2
+        scan pad) was silently lost. ``per_chunk_compiles`` keeps the
+        attribution: entry i is the number of driver executables chunk
+        i compiled, so "zero recompiles across controller adaptations"
+        is testable per chunk, not just in aggregate."""
+        self.last_run_stats = {
+            "supersteps": sum(c["supersteps"] for c in chunks),
+            "wall_seconds": sum(c["wall_seconds"] for c in chunks),
+            "compiles": sum(c["compiles"] for c in chunks),
+            "chunks": len(chunks),
+            "per_chunk_compiles": [c["compiles"] for c in chunks],
         }
         return self.last_run_stats

@@ -60,6 +60,7 @@ from ...trace.hashing import FIRED, RECV, SENT, mix32_jnp
 from .common import I32MAX as _I32MAX
 from .common import LocalComm, RunStatsMixin, StepOut as _StepOut
 from .common import padded_scan, scan_pad
+from .controlled import ControlledRunMixin
 from .common import thi as _thi, tlo as _tlo, u32sum as _u32sum
 
 __all__ = ["EdgeEngine", "EdgeState", "EdgeTopology"]
@@ -189,7 +190,7 @@ class EdgeState(NamedTuple):
     restart_done: jax.Array
 
 
-class EdgeEngine(RunStatsMixin):
+class EdgeEngine(RunStatsMixin, ControlledRunMixin):
     """Batched engine for static-topology scenarios. Same driver API as
     :class:`~timewarp_tpu.interp.jax_engine.engine.JaxEngine`: ``run``
     (traced, per-superstep rows) and ``run_quiet`` (while_loop, no
@@ -201,7 +202,7 @@ class EdgeEngine(RunStatsMixin):
     def __init__(self, scenario: Scenario, link: LinkModel, *,
                  seed: int = 0, cap: int = 2,
                  lint: str = "warn", faults=None,
-                 telemetry: str = "off") -> None:
+                 telemetry: str = "off", controller=None) -> None:
         # static scenario sanitizer — same knob contract as JaxEngine
         from ...analysis import check_scenario
         from ...obs.telemetry import validate_mode
@@ -224,6 +225,13 @@ class EdgeEngine(RunStatsMixin):
                                        scenario.n_nodes)
         self.comm = LocalComm(scenario.n_nodes)
         self._setup_faults(faults, scenario, lint)
+        # online dispatch (dispatch/): the edge engine runs classic
+        # W=1 supersteps and has no routing ladder, so the controller
+        # adapts CHUNK LENGTH only — window/rung ride the decision
+        # trace pinned (1 / -1). `window` exists for the controller's
+        # bound query; `_dyn_ok` stays False (ControlledRunMixin).
+        self.window = 1
+        self._bind_controller(controller)
 
     # -- faults (same semantics/masks as JaxEngine, classic W=1) ---------
 
@@ -603,6 +611,12 @@ class EdgeEngine(RunStatsMixin):
     #: the edge engine carries no world axis (batch=BatchSpec is the
     #: general engine's lever); the shared drivers key off this
     batch = None
+
+    def world_active(self, state) -> jax.Array:
+        """Liveness probe (JaxEngine.world_active's solo twin): True
+        while an event is pending — the controller drivers
+        (controlled.py) test it between chunks."""
+        return self._next_event(state) < NEVER
 
     def _step_all(self, st, with_trace: bool):
         """One driver step (the ShardedDriver/scan hook — the edge

@@ -53,6 +53,7 @@ from .common import LocalComm, RunStatsMixin, StepOut as _StepOut
 from .common import group_rank
 from .common import padded_scan, scan_pad as _scan_pad
 from .common import thi as _thi, tlo as _tlo, u32sum as _u32sum
+from .controlled import ControlledRunMixin
 
 __all__ = ["JaxEngine", "EngineState", "BatchSpec"]
 
@@ -113,7 +114,7 @@ class EngineState(NamedTuple):
     restart_done: jax.Array
 
 
-class JaxEngine(RunStatsMixin):
+class JaxEngine(RunStatsMixin, ControlledRunMixin):
     """Single-chip batched engine for arbitrary (dynamic-destination)
     scenarios. ``run(max_steps)`` executes up to ``max_steps``
     supersteps under one ``lax.scan`` and returns the final
@@ -231,6 +232,19 @@ class JaxEngine(RunStatsMixin):
     world b runs its own schedule, and the batch exactness law
     extends: world-b slice of a chaos fleet ≡ the solo run with
     ``fleet.world_schedule(b)`` (docs/faults.md).
+
+    Online adaptive dispatch (``controller=DispatchController(...)``,
+    dispatch/ + controlled.py, docs/dispatch.md): ``window`` then
+    names the dynamic window's *bound* (resolve it with ``"auto"`` —
+    the UNDEGRADED link floor; degradation windows clamp on-device
+    per superstep, faults/apply.py ``window_floor``), and
+    :meth:`run_controlled` executes chunk by chunk with the
+    controller's per-chunk window/rung-pin values threaded as traced
+    scalars — adapting never retraces, every decision is recorded,
+    and replaying the decision trace is bit-identical on states,
+    traces, digests, and checkpoints (the replay law,
+    tests/test_zzzdispatch.py). Engines with a Pallas insertion
+    stage adapt chunk length only (the kernels bake the window).
     """
 
     def __init__(self, scenario: Scenario, link: LinkModel, *,
@@ -242,7 +256,8 @@ class JaxEngine(RunStatsMixin):
                  faults=None,
                  telemetry: str = "off",
                  insert: Optional[str] = None,
-                 insert_cap: Optional[int] = None) -> None:
+                 insert_cap: Optional[int] = None,
+                 controller=None) -> None:
         # static scenario sanitizer (analysis/): "warn" logs findings,
         # "error" refuses to construct on contract violations, "off"
         # skips entirely (bit-for-bit the pre-lint behavior — the
@@ -291,6 +306,25 @@ class JaxEngine(RunStatsMixin):
         self.scenario = scenario  # before faults: the restart-reset
         self.link = link          # template stacks Scenario.init
         self._setup_faults(faults, scenario, lint)
+        # the insert strategy is resolved BEFORE window validation: a
+        # Pallas insertion stage bakes the window into kernel
+        # arithmetic, so those engines cannot thread the dynamic
+        # per-superstep window clamp — their window (controller or
+        # not) must validate against the DEGRADED floor below. The
+        # stage itself is built further down (it needs the resolved
+        # window).
+        from .pallas_insert import resolve_insert
+        (self.insert, self.insert_resolved, self.insert_fallback,
+         _ins_env) = resolve_insert(
+            insert, honor_env=type(self) is JaxEngine,
+            who=type(self).__name__)
+        #: whether this engine threads the dynamic window/rung scalars
+        #: (controlled.py) — a kernel-window engine adapts chunk
+        #: length only. The env-fallback path below may downgrade the
+        #: resolved insert to "xla" later; that only makes the bound
+        #: chosen here CONSERVATIVE (degraded), never unsafe.
+        self._dyn_ok = self.insert_resolved not in ("pallas",
+                                                    "interpret")
         if self._faulted:
             if route_cap is not None:
                 raise ValueError(
@@ -300,8 +334,18 @@ class JaxEngine(RunStatsMixin):
                     "study uncapped (adaptive routing never drops)")
             # a shrink-degradation window can undercut the link's
             # declared floor: windowed validation (and "auto") must
-            # use the degraded worst case, never silently reorder
-            link_floor = self.faults.min_delay_floor(link_floor)
+            # use the degraded worst case, never silently reorder.
+            # Controller engines that thread the DYNAMIC window keep
+            # the UNDEGRADED floor as their bound: the device-side
+            # per-superstep clamp (faults/apply.py window_floor)
+            # narrows the effective window for exactly the supersteps
+            # a degradation window overlaps, so the whole run is not
+            # forced onto the schedule-wide conservative floor
+            # (docs/dispatch.md). An engine whose window is a kernel
+            # constant has no clamp point — it MUST take the degraded
+            # floor like any static engine.
+            if controller is None or not self._dyn_ok:
+                link_floor = self.faults.min_delay_floor(link_floor)
         if isinstance(window, str) and window != "auto":
             # a typo'd "Auto"/"8ms" from a library caller would
             # otherwise fall through to `window < 1` and raise an
@@ -316,7 +360,10 @@ class JaxEngine(RunStatsMixin):
             # floor-less link (min 1) degenerates to the classic
             # engine — correct, just unbatched. Batched: the min over
             # every world's link, so the window is exact fleet-wide.
-            window = max(1, int(link_floor))
+            # Clamped to int32: a FOREVER-delay link (e.g. --link
+            # never) declares an astronomical floor, and "auto" must
+            # resolve to the widest REPRESENTABLE window, not refuse
+            window = max(1, min(int(link_floor), _I32MAX - 1))
         if window < 1:
             raise ValueError(f"window must be >= 1 µs, got {window}")
         if window > 1 and window > link_floor:
@@ -364,11 +411,8 @@ class JaxEngine(RunStatsMixin):
         # only: subclasses that replace the insertion stage themselves
         # must not inherit it). Every strategy is bit-identical —
         # the exactness law tests/test_pallas_insert.py pins.
-        from .pallas_insert import resolve_insert
-        (self.insert, self.insert_resolved, self.insert_fallback,
-         _ins_env) = resolve_insert(
-            insert, honor_env=type(self) is JaxEngine,
-            who=type(self).__name__)
+        # (Resolved ABOVE, before window validation — the kernel-
+        # window engines must validate against the degraded floor.)
         # insert_cap sizes the pallas stage, so it needs a kernel mode
         # — judged on the REQUESTED mode, not the resolved one: a
         # script written for the chip (insert="pallas", insert_cap=N)
@@ -415,6 +459,22 @@ class JaxEngine(RunStatsMixin):
         #: insertion stage ranks holes in-tile the same way
         self._fused_holes = (self._pallas_stage is not None
                              and scenario.commutative_inbox)
+        # online adaptive dispatch (dispatch/, controlled.py): the
+        # engine's `window` is then the dynamic knob's BOUND, and the
+        # per-chunk values arrive as traced scalars (self._dyn) — no
+        # retrace between adaptations. `_w_now` is the superstep's
+        # effective window value, == self.window (a Python int, so the
+        # controller-less jaxpr is unchanged) on the static path.
+        self._dyn = None
+        self._w_now = self.window
+        # `_dyn_ok` was fixed BEFORE window validation (above): a
+        # Pallas insertion stage bakes the window into kernel
+        # arithmetic (the in-kernel short-delay counter compares
+        # against the compile-time W), so those engines adapt chunk
+        # length only — knob values are recorded pinned, and their
+        # window bound already took the degraded floor like any
+        # static engine
+        self._bind_controller(controller)
 
     # -- faults (faults/: scheduled chaos inside the superstep) ----------
 
@@ -571,7 +631,11 @@ class JaxEngine(RunStatsMixin):
         drel64 = woff.astype(jnp.int64) + flight
         bad = jnp.sum(ok & (drel64 > jnp.int64(_I32MAX - 1)),
                       dtype=jnp.int32)
-        short = jnp.sum(ok & (flight < self.window), dtype=jnp.int32) \
+        # `_w_now` is the superstep's EFFECTIVE window (the dynamic
+        # clamp's output under a controller; the static int otherwise)
+        # — a flight shorter than what actually ran this superstep is
+        # the violation, not one shorter than the bound
+        short = jnp.sum(ok & (flight < self._w_now), dtype=jnp.int32) \
             if self.window > 1 else jnp.int32(0)
         drel = jnp.minimum(drel64,
                            jnp.int64(_I32MAX - 1)).astype(jnp.int32)
@@ -825,6 +889,15 @@ class JaxEngine(RunStatsMixin):
                 self._t_rung = jnp.int32(rungs[-1])
             return tail(rungs[-1])()
         idx = jnp.sum(n_active > jnp.asarray(rungs, jnp.int32))
+        if self._dyn is not None:
+            # controller rung pin (dispatch/): a traced FLOOR on the
+            # selected index — max(computed, pin) can only pick a
+            # wider rung, which is result-identical by the ladder's
+            # own construction (any rung that fits is), so pinning
+            # against thrash can never drop a message. -1 = unpinned.
+            pin = jnp.clip(self._dyn.rung_pin, jnp.int32(-1),
+                           jnp.int32(len(rungs) - 1))
+            idx = jnp.maximum(idx, pin.astype(idx.dtype))
         if self.telemetry != "off":
             # the rung the switch actually takes — recorded where the
             # decision is made, so telemetry can never drift from it
@@ -990,12 +1063,27 @@ class JaxEngine(RunStatsMixin):
                                    st.restart_done)
         t = comm.all_min(node_next.min())
         live = t < NEVER
+        # dynamic dispatch (controlled.py): the controller's requested
+        # window arrives as a traced scalar, clamped to [1, bound] and
+        # — under a fault schedule — to the per-superstep degraded
+        # link floor over [t, t + request) (faults/apply.window_floor:
+        # a degradation window that undercuts the declared floor
+        # narrows exactly the supersteps it overlaps). Static engines
+        # keep W the Python int it always was — jaxpr unchanged.
+        if self._dyn is not None:
+            Wv = jnp.clip(self._dyn.window, jnp.int64(1), jnp.int64(W))
+            if self._faulted:
+                from ...faults.apply import window_floor
+                Wv = window_floor(self._ft, t, Wv, W)
+        else:
+            Wv = W
+        self._w_now = Wv
         # windowed firing: every node with an event in [t, t+W) fires,
         # each at its OWN instant (W=1 degenerates to == t, since t is
         # the global min). In-window firings are causally independent
         # because link delays are >= W (validated in __init__; counted
         # in short_delay below when violated).
-        fire = (node_next < NEVER) & (node_next - t < W) & live
+        fire = (node_next < NEVER) & (node_next - t < Wv) & live
         #: per-node firing instant; t for non-fired (their results are
         #: masked, but the step function must see a sane `now`)
         now_vec = jnp.where(fire, node_next, t)                 # int64[N]
@@ -1289,9 +1377,10 @@ class JaxEngine(RunStatsMixin):
             # windowed-causality violation: a delay shorter than the
             # window means this message should have been visible to a
             # node that already fired in this very window — counted,
-            # never silent
+            # never silent (against the effective window, see
+            # _sample_nodrop)
             short_step = comm.all_sum(jnp.sum(
-                ok & (flight < W), dtype=jnp.int32)) \
+                ok & (flight < self._w_now), dtype=jnp.int32)) \
                 if W > 1 else jnp.int32(0)
             drel = jnp.minimum(drel64,
                                jnp.int64(_I32MAX - 1)).astype(jnp.int32)
@@ -1592,13 +1681,22 @@ class JaxEngine(RunStatsMixin):
     # -- drivers ---------------------------------------------------------
 
     @partial(jax.jit, static_argnums=(0, 2))
-    def _run_scan(self, st: EngineState, n_pad: int, max_steps):
+    def _run_scan(self, st: EngineState, n_pad: int, max_steps,
+                  dyn=None):
         """Traced driver: ``n_pad`` (static) is the pow2-padded scan
         length (common.py ``scan_pad``), ``max_steps`` (traced) the
         real budget — the shared ``padded_scan`` body computes and
         discards the tail, so every budget in a pow2 bucket shares
-        one executable."""
-        return padded_scan(self._step_all, st, n_pad, max_steps)
+        one executable. ``dyn`` (traced ``DynDispatch``, or None) is
+        the controller's knob operand: bound onto ``self`` for the one
+        trace this jit performs, so the scan body reads the traced
+        scalars — new knob values re-invoke the SAME executable (the
+        no-retrace-in-the-hot-loop contract, controlled.py)."""
+        self._dyn = dyn
+        try:
+            return padded_scan(self._step_all, st, n_pad, max_steps)
+        finally:
+            self._dyn = None
 
     def _decode_traces(self, ys) -> list:
         """Per-world trace decode of batched scan output ([T, B]
@@ -1639,19 +1737,26 @@ class JaxEngine(RunStatsMixin):
         return jnp.asarray(budgets, jnp.int64), top
 
     def run(self, max_steps,
-            state: Optional[EngineState] = None
-            ) -> Tuple[EngineState, SuperstepTrace]:
+            state: Optional[EngineState] = None, *,
+            _dyn=None) -> Tuple[EngineState, SuperstepTrace]:
         """Execute up to ``max_steps`` supersteps; returns final state
         and the trace of the supersteps that actually fired — batched
         engines return a **list** of per-world traces. Batched engines
         also accept a length-B sequence of per-world budgets: world b
         freezes after its own budget, bit-identical to the solo run
         with that budget (the sweep service's heterogeneous-budget
-        buckets — padded_scan in common.py)."""
+        buckets — padded_scan in common.py). ``_dyn`` is the
+        controller drivers' traced knob operand (controlled.py /
+        sweep/runner.py) — passing one requires a bound controller,
+        so a stray caller cannot silently run off-spec knob values."""
+        if _dyn is not None and self.controller is None:
+            raise ValueError(
+                "_dyn carries dispatch-controller knob values; build "
+                "the engine with controller= (docs/dispatch.md)")
         st = state if state is not None else self.init_state()
         budget, top = self._coerce_budget(max_steps)
         begin = self._stats_begin()
-        final, ys = self._run_scan(st, _scan_pad(top), budget)
+        final, ys = self._run_scan(st, _scan_pad(top), budget, _dyn)
         ys = jax.device_get(ys)
         self._stats_end(begin, st.steps, final.steps)
         self._capture_telemetry(ys)
@@ -1775,6 +1880,8 @@ class JaxEngine(RunStatsMixin):
         start = np.asarray(jax.device_get(st.steps), np.int64)
         rows = [[] for _ in range(B)]
         emitted = np.zeros(B, bool)
+        chunk_stats = []
+        frame_chunks = []
         while True:
             _, remaining, active = self.fleet_progress(st, budgets,
                                                        start)
@@ -1786,11 +1893,27 @@ class JaxEngine(RunStatsMixin):
                 break
             vec = np.where(active, np.minimum(remaining, chunk), 0)
             st, traces = self.run(vec, state=st)
+            chunk_stats.append(self.last_run_stats)
+            frame_chunks.append(self.last_run_telemetry)
             if on_chunk is not None:
                 on_chunk(st, traces)
             for b in range(B):
                 rows[b].extend(traces[b].row(i)
                                for i in range(len(traces[b])))
+        if self.telemetry != "off":
+            # whole-run telemetry on last_run_telemetry, exactly like
+            # run_controlled (controlled.py) — a chunked run must not
+            # leave only its final chunk's frames behind
+            from ...obs.telemetry import concat_frames
+            self.last_run_telemetry = concat_frames(frame_chunks)
+        if chunk_stats:
+            # chunk-accurate driver accounting: each run() overwrote
+            # last_run_stats, so the chunked run used to report only
+            # its FINAL chunk — compiles landing on earlier chunks
+            # (the first use of each pow2 scan pad) vanished. The
+            # merged record keeps per-chunk compile attribution
+            # (common.py _stats_merge).
+            self._stats_merge(chunk_stats)
         return st, [SuperstepTrace.from_rows(r) for r in rows]
 
     def events(self, state: EngineState):

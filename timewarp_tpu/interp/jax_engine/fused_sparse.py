@@ -200,10 +200,17 @@ class FusedSparseEngine(JaxEngine):
     def __init__(self, scenario: Scenario, link: LinkModel, *,
                  seed: int = 0, window=1, record_events: int = 0,
                  max_batch: int = 1 << 16,
-                 lint: str = "warn", telemetry: str = "off") -> None:
+                 lint: str = "warn", telemetry: str = "off",
+                 controller=None) -> None:
         super().__init__(scenario, link, seed=seed, window=window,
                          route_cap=None, record_events=record_events,
-                         lint=lint, telemetry=telemetry)
+                         lint=lint, telemetry=telemetry,
+                         controller=controller)
+        # the fused kernel bakes the window into its uint32 deliver
+        # arithmetic and in-kernel short-delay counter, so a dispatch
+        # controller adapts CHUNK LENGTH only here — window/rung ride
+        # the decision trace pinned (dispatch/, controlled.py)
+        self._dyn_ok = False
         sc = scenario
         if link.can_drop:
             raise ValueError(

@@ -204,10 +204,11 @@ class ShardedBatchedEngine(ShardedDriver, JaxEngine):
                  axis: AxisName = "worlds", seed: int = 0,
                  window=1, route_cap: Optional[int] = None,
                  lint: str = "warn", faults=None,
-                 telemetry: str = "off") -> None:
+                 telemetry: str = "off", controller=None) -> None:
         super().__init__(scenario, link, seed=seed, window=window,
                          route_cap=route_cap, lint=lint, batch=batch,
-                         faults=faults, telemetry=telemetry)
+                         faults=faults, telemetry=telemetry,
+                         controller=controller)
         if batch is None:
             raise ValueError(
                 "ShardedBatchedEngine shards the world axis; it needs "

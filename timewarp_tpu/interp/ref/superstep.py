@@ -82,7 +82,11 @@ class SuperstepOracle:
                 f"window must be an int µs count or the string "
                 f"'auto', got {window!r}")
         if window == "auto":    # mirror JaxEngine: link floor = widest
-            window = max(1, int(link_floor))  # exact window
+            # exact window, int32-clamped exactly like the engine (a
+            # FOREVER-delay link must resolve the same width in both
+            # interpreters or windowed parity would silently split)
+            from ..jax_engine.common import I32MAX
+            window = max(1, min(int(link_floor), I32MAX - 1))
         if window < 1:
             raise ValueError(f"window must be >= 1 µs, got {window}")
         if window > 1 and window > link_floor:

@@ -2,7 +2,7 @@
 
 One line per observation, every line self-describing::
 
-    {"schema": 1, "kind": "supersteps", "label": "...", ...}
+    {"schema": 2, "kind": "supersteps", "label": "...", ...}
 
 Kinds:
 
@@ -17,6 +17,9 @@ Kinds:
 - ``utilization`` — per-bucket sweep utilization (sweep/runner.py):
   worlds-active occupancy, budget-mask efficiency, pow2 scan-pad
   waste.
+- ``decision`` — one online-dispatch controller decision per chunk
+  (dispatch/, docs/dispatch.md): window width, rung pin, chunk
+  length.
 - ``event`` — a point event (OOM split, terminal failure, …).
 
 The registry validates every line at emit time AND the file is
@@ -40,8 +43,10 @@ from typing import Any, Dict, List, Optional
 __all__ = ["METRICS_SCHEMA", "MetricsRegistry", "validate_line",
            "validate_metrics_file"]
 
-#: bump when a kind's required fields change shape
-METRICS_SCHEMA = 1
+#: bump when a kind's required fields change shape (or the kind
+#: inventory grows: v2 added the dispatch-controller `decision`
+#: kind — a v1 reader would mis-skip lines it cannot interpret)
+METRICS_SCHEMA = 2
 
 _NUM = (int, float)
 #: kind -> {required field: type tuple}; extra fields are allowed
@@ -57,6 +62,11 @@ _KINDS: Dict[str, Dict[str, tuple]] = {
                     "budget_efficiency": _NUM,
                     "pad_waste_frac": _NUM,
                     "worlds_active_mean": _NUM},
+    # one online-dispatch controller decision per chunk (dispatch/,
+    # docs/dispatch.md): the knob values a chunk ran with — the same
+    # record the decision trace and the sweep journal carry
+    "decision": {"chunk": (int,), "window_us": (int,),
+                 "rung_pin": (int,), "chunk_len": (int,)},
     "event": {"name": (str,)},
 }
 
@@ -67,10 +77,15 @@ def validate_line(rec: Any) -> None:
     if not isinstance(rec, dict):
         raise ValueError(f"metrics line must be a JSON object, got "
                          f"{type(rec).__name__}")
-    if rec.get("schema") != METRICS_SCHEMA:
+    sv = rec.get("schema")
+    # accept every schema this reader understands: bumps so far are
+    # purely additive (v2 added the `decision` kind), so a v1 archive
+    # must keep validating — only a FUTURE schema is unreadable
+    if isinstance(sv, bool) or not isinstance(sv, int) \
+            or not 1 <= sv <= METRICS_SCHEMA:
         raise ValueError(
-            f"metrics line schema {rec.get('schema')!r} != "
-            f"{METRICS_SCHEMA} (this reader)")
+            f"metrics line schema {sv!r} outside this reader's range "
+            f"[1, {METRICS_SCHEMA}]")
     kind = rec.get("kind")
     if kind not in _KINDS:
         raise ValueError(f"unknown metrics kind {kind!r}; known: "

@@ -37,7 +37,8 @@ from typing import Any, Dict, NamedTuple, Optional
 import numpy as np
 
 __all__ = ["TELEMETRY_MODES", "TelemetryRow", "TelemetryFrames",
-           "validate_mode", "decode_frames", "summarize_frames"]
+           "validate_mode", "decode_frames", "summarize_frames",
+           "concat_frames"]
 
 #: the engine knob's legal values, in increasing cost order
 TELEMETRY_MODES = ("off", "counters", "full")
@@ -123,6 +124,28 @@ def decode_frames(telem, valid, t_us, n_worlds: Optional[int] = None):
     if n_worlds is None:
         return one(None)
     return [one(b) for b in range(n_worlds)]
+
+
+def concat_frames(chunks):
+    """Concatenate per-chunk decodes into one run-level view — what
+    the controller drivers (interp/jax_engine/controlled.py) leave on
+    ``last_run_telemetry`` so post-run exporters (the CLI's
+    ``--metrics-out``/``--trace-out``) see the WHOLE run, not the
+    final chunk. ``chunks`` is a list of ``TelemetryFrames`` (solo)
+    or a list of per-world lists (batched) — returns the same shape
+    as one chunk."""
+    chunks = [c for c in chunks if c is not None]
+    if not chunks:
+        return None
+    if isinstance(chunks[0], list):
+        B = len(chunks[0])
+        return [concat_frames([c[b] for c in chunks])
+                for b in range(B)]
+    keys = [k for k in FIELDS if k in chunks[0].data]
+    return TelemetryFrames(
+        t_us=np.concatenate([c.t_us for c in chunks]),
+        data={k: np.concatenate([c.data[k] for c in chunks])
+              for k in keys})
 
 
 def _stats(v: np.ndarray) -> dict:

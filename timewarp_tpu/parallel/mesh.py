@@ -191,16 +191,50 @@ class ShardedDriver:
         return P()
 
     @partial(jax.jit, static_argnums=(0, 2))
-    def _run_scan(self, st, n_pad: int, max_steps):
+    def _run_scan(self, st, n_pad: int, max_steps, dyn=None):
         # pow2-padded scan length + masked tail, the shared
-        # compile-reuse contract (jax_engine/common.py padded_scan)
+        # compile-reuse contract (jax_engine/common.py padded_scan).
+        # `dyn` is the dispatch controller's traced knob operand
+        # (jax_engine/controlled.py) — replicated scalars, bound onto
+        # `self` inside the shard_map body exactly like the local
+        # driver binds them, so one superstep implementation reads
+        # them in both venues
         specs = self._state_specs(st)
+        # per-world budget vectors on the WORLD-sharded engine: the
+        # replicated [B] budget must mask this device's local world
+        # slice (the scan carry is [B/D, ...]) — slice it by mesh
+        # position exactly like _step_all slices the world context.
+        # Node-sharded engines never see a vector (batch is None).
+        Bl = getattr(self, "worlds_local", None)
+        ms_vec = getattr(max_steps, "ndim", 0) == 1
 
-        def body(s, ms):
-            return padded_scan(self._step_all, s, n_pad, ms)
+        def local_ms(ms):
+            if not ms_vec or Bl is None:
+                return ms
+            off = jax.lax.axis_index(self.axis).astype(jnp.int32) \
+                * jnp.int32(Bl)
+            return jax.lax.dynamic_slice_in_dim(ms, off, Bl, 0)
 
-        return _smap(body, self.mesh, (specs, P()),
-                     (specs, self._trace_spec()))(st, max_steps)
+        if dyn is None:
+            def body(s, ms):
+                return padded_scan(self._step_all, s, n_pad,
+                                   local_ms(ms))
+
+            return _smap(body, self.mesh, (specs, P()),
+                         (specs, self._trace_spec()))(st, max_steps)
+
+        dyn_specs = jax.tree.map(lambda _: P(), dyn)
+
+        def body_dyn(s, ms, dy):
+            self._dyn = dy
+            try:
+                return padded_scan(self._step_all, s, n_pad,
+                                   local_ms(ms))
+            finally:
+                self._dyn = None
+
+        return _smap(body_dyn, self.mesh, (specs, P(), dyn_specs),
+                     (specs, self._trace_spec()))(st, max_steps, dyn)
 
     @partial(jax.jit, static_argnums=(0,))
     def _run_while(self, st, max_steps):

@@ -39,6 +39,9 @@ __all__ = ["Bucket", "plan_buckets", "build_bucket_engine"]
 
 @dataclass(frozen=True)
 class Bucket:
+    # NOTE: `controller` property below reports whether this bucket's
+    # worlds run under online adaptive dispatch (all members agree —
+    # it is part of the bucket key).
     """One schedulable unit: an ordered world list sharing a batched
     executable. ``bucket_id`` is stable across resume (derived from
     the deterministic plan; split children append ``.0``/``.1``).
@@ -66,6 +69,10 @@ class Bucket:
     def budgets(self) -> np.ndarray:
         return np.asarray([c.budget for c in self.configs], np.int64)
 
+    @property
+    def controller(self) -> bool:
+        return self.configs[0].controller == "auto"
+
     def split(self) -> Tuple["Bucket", "Bucket"]:
         """Halve the bucket (the OOM degradation path, service.py):
         two children over the same window, ids suffixed so resume can
@@ -83,8 +90,12 @@ class Bucket:
 
 
 def _bucket_key(cfg: RunConfig):
+    # controller is part of the bucket's identity: the dispatch
+    # controller makes ONE decision sequence per bucket (journaled;
+    # replayed by every member's solo twin), so controller-on and
+    # controller-off worlds can never share an executable's chunking
     return (cfg.family, cfg.params, link_signature(cfg.parse_link()),
-            resolve_window(cfg))
+            resolve_window(cfg), cfg.controller)
 
 
 def plan_buckets(configs, max_bucket: int = 64) -> List[Bucket]:
@@ -105,7 +116,7 @@ def plan_buckets(configs, max_bucket: int = 64) -> List[Bucket]:
 
 
 def build_bucket_engine(bucket: Bucket, *, lint: str = "warn",
-                        telemetry: str = "off"):
+                        telemetry: str = "off", controller=None):
     """One batched :class:`~timewarp_tpu.interp.jax_engine.engine.
     JaxEngine` serving every world of the bucket. World b's seed,
     sweepable link values, and (padded) fault schedule are exactly
@@ -138,7 +149,14 @@ def build_bucket_engine(bucket: Bucket, *, lint: str = "warn",
     empty = all(not s.events for s in scheds)
     fleet = None if empty and (pad is None or tuple(pad) == (0, 0, 0)) \
         else FaultFleet(tuple(scheds))
+    if bucket.controller and telemetry == "off":
+        # an auto controller reads last_run_telemetry between chunks
+        # — a controller bucket without the sensor layer cannot
+        # decide; force the cheap counters mode (bit-exact by the
+        # telemetry law, so streamed results are unchanged)
+        telemetry = "counters"
     eng = JaxEngine(sc, links[0], window=bucket.window, batch=spec,
-                    faults=fleet, lint=lint, telemetry=telemetry)
+                    faults=fleet, lint=lint, telemetry=telemetry,
+                    controller=controller)
     eng.metrics_label = f"bucket:{bucket.bucket_id}"
     return eng

@@ -108,8 +108,16 @@ def _finish(svc: SweepService, verify: bool) -> int:
         out["metrics"] = svc.metrics.path
     if verify:
         mismatches = []
+        scan = svc.journal.scan() if any(
+            c.controller == "auto" for c in svc.pack.configs) else None
         for rid, res in sorted(report.done.items()):
-            want = solo_result(svc.pack.by_id(rid), lint="off")
+            cfg = svc.pack.by_id(rid)
+            # controller worlds: the solo twin replays the bucket's
+            # journaled decision chain (the replay law carries the
+            # survival law — docs/dispatch.md)
+            decs = svc.decisions_for_world(rid, scan) \
+                if cfg.controller == "auto" else None
+            want = solo_result(cfg, lint="off", decisions=decs)
             if want != res:
                 mismatches.append(
                     {"run_id": rid, "solo": want, "streamed": res})

@@ -55,6 +55,34 @@ class JournalState:
     #: bucket_id -> utilization record (obs: worlds-active occupancy,
     #: budget-mask efficiency, pow2 pad waste — sweep/runner.py)
     util: Dict[str, dict] = field(default_factory=dict)
+    #: bucket_id -> ordered dispatch-controller decision records
+    #: (dispatch/trace.py schema), journaled BEFORE each chunk runs —
+    #: resume replays them so a pre-kill decision is never re-made
+    #: differently (docs/dispatch.md)
+    decisions: Dict[str, List[dict]] = field(default_factory=dict)
+    #: run_id -> bucket_id that streamed its result (what --verify
+    #: uses to assemble a controller world's decision chain)
+    world_bucket: Dict[str, str] = field(default_factory=dict)
+
+    def decision_chain(self, bucket_id: str) -> List[dict]:
+        """Every decision record governing ``bucket_id``'s worlds, in
+        chunk order. A split child (``b3.0.1``) continued its parent's
+        chunk numbering from the parent's checkpoint, so the chain is
+        the ancestor prefixes (``b3``, ``b3.0``) plus the child's own
+        records — the sequence a solo replay twin re-applies. Dedup by
+        chunk index (ancestor first): a chunk the parent decided but
+        never durably executed is reused, not re-decided, by the
+        child (sweep/runner.py)."""
+        parts = bucket_id.split(".")
+        ids = [".".join(parts[:i + 1]) for i in range(len(parts))]
+        out: List[dict] = []
+        seen: Set[int] = set()
+        for bid in ids:
+            for d in self.decisions.get(bid, []):
+                if d["chunk"] not in seen:
+                    seen.add(d["chunk"])
+                    out.append(d)
+        return sorted(out, key=lambda d: d["chunk"])
 
 
 class SweepJournal:
@@ -168,6 +196,7 @@ class SweepJournal:
                         f"  first:  {st.done[rid]}\n"
                         f"  second: {rec['result']}")
                 st.done[rid] = rec["result"]
+                st.world_bucket[rid] = rec.get("bucket", "")
             elif ev == "world_failed":
                 st.failed[rec["run_id"]] = rec
             elif ev == "bucket_done":
@@ -182,4 +211,27 @@ class SweepJournal:
                     k: v for k, v in rec.items() if k != "ev"}
             elif ev == "retry":
                 st.retries += 1
+            elif ev == "dispatch_decision":
+                dl = st.decisions.setdefault(rec["bucket"], [])
+                d = rec["decision"]
+                dup = next((p for p in dl
+                            if p["chunk"] == d["chunk"]), None)
+                if dup is not None:
+                    knobs = ("window_us", "rung_pin", "chunk_len")
+                    if any(dup[k] != d[k] for k in knobs):
+                        # the one unforgivable controller state: two
+                        # different decisions claim the same chunk —
+                        # a replayed resume would match neither run
+                        raise SweepJournalError(
+                            f"bucket {rec['bucket']!r} chunk "
+                            f"{d['chunk']} is double-journaled with "
+                            f"DIFFERENT dispatch decisions — "
+                            f"refusing to pick one:\n  first:  {dup}"
+                            f"\n  second: {d}")
+                    _log.warning("sweep journal: duplicate dispatch "
+                                 "decision for bucket %r chunk %d "
+                                 "(identical knobs)", rec["bucket"],
+                                 d["chunk"])
+                else:
+                    dl.append(d)
         return st

@@ -57,7 +57,8 @@ class BucketRunner:
     def __init__(self, bucket: Bucket, journal: SweepJournal,
                  done: Dict[str, dict], *, lint: str = "warn",
                  chunk: int = 64, inject=None,
-                 telemetry: str = "off", metrics=None) -> None:
+                 telemetry: str = "off", metrics=None,
+                 prior_decisions=()) -> None:
         self.bucket = bucket
         self.journal = journal
         #: shared run_id -> result map (journaled results land here
@@ -66,6 +67,17 @@ class BucketRunner:
         self.lint = lint
         self.chunk = int(chunk)
         self.inject = inject
+        #: online adaptive dispatch (dispatch/, docs/dispatch.md):
+        #: controller buckets decide window/rung/chunk-length per
+        #: chunk, journal each FRESH decision before its chunk runs
+        #: (under the epoch lock — a zombie attempt can neither
+        #: decide nor journal), and REPLAY `prior_decisions` (the
+        #: journaled chain, resume/split) instead of re-deciding
+        self.ctrl = None
+        self.prior_decisions = list(prior_decisions)
+        #: chunks durably executed (checkpoint meta "chunks") — the
+        #: next decision's index
+        self.chunks = 0
         #: engine telemetry mode + optional obs.metrics.MetricsRegistry
         #: (the engine chunk-flushes `supersteps` lines into it)
         self.telemetry = telemetry
@@ -124,9 +136,22 @@ class BucketRunner:
         transient crash costs at most one chunk of progress."""
         self._check(epoch)
         engine = self.engine
+        ctrl = self.ctrl
         if engine is None:
+            if self.bucket.controller:
+                from ..dispatch import DispatchController
+                # the operator's --chunk stays the CEILING: it bounds
+                # memory per executable and checkpoint granularity (a
+                # crash loses at most one chunk) — the controller
+                # adapts downward within it, never past it
+                ctrl = DispatchController(
+                    mode="auto", chunk=self.chunk,
+                    chunk_min=min(8, self.chunk),
+                    chunk_max=self.chunk,
+                    replay=self.prior_decisions)
             engine = build_bucket_engine(self.bucket, lint=self.lint,
-                                         telemetry=self.telemetry)
+                                         telemetry=self.telemetry,
+                                         controller=ctrl)
             engine.metrics = self.metrics
         path = self.journal.checkpoint_path(self.bucket.bucket_id)
         B = self.bucket.B
@@ -138,18 +163,29 @@ class BucketRunner:
                              "run_ids": list(self.bucket.run_ids)})
             digests = list(meta["digests"])
             supersteps = [int(s) for s in meta["supersteps"]]
+            chunks = int(meta.get("chunks", 0))
         else:
             st = engine.init_state()
             digests = [DIGEST_ZERO] * B
             supersteps = [0] * B
+            chunks = 0
         with self._lock:
             self._check(epoch)
             if self.engine is None:
                 self.engine = engine
+                self.ctrl = ctrl
+                if ctrl is not None:
+                    ctrl.begin(engine)
             self.state = st
             self.digests = digests
             self.supersteps = supersteps
+            self.chunks = chunks
             self.emitted = set(self.done)
+            # a retry restarts from the checkpoint: the telemetry the
+            # in-flight chunk produced is gone, which is exactly why
+            # its journaled decision (if any) is REUSED, not re-made
+            if self.engine is not None:
+                self.engine.last_run_telemetry = None
 
     def fault_pad(self):
         """The engine's realized fault-table pad shape — what split
@@ -196,13 +232,48 @@ class BucketRunner:
         if not active.any():
             self._finish_util(epoch)
             return "done"
-        vec = np.where(active, np.minimum(remaining, self.chunk), 0)
+        run_kw = {}
+        chunk_len = self.chunk
+        ci = self.chunks
+        if self.ctrl is not None:
+            # decide + journal atomically under the epoch lock: a
+            # zombie attempt must neither mint a decision nor journal
+            # one, and a FRESH decision is durable BEFORE its chunk
+            # runs — so a kill mid-chunk resumes by replaying it,
+            # never re-deciding from telemetry the crash destroyed
+            t_now = int(np.min(np.asarray(st.time)))
+            with self._lock:
+                self._check(epoch)
+                dec, fresh = self.ctrl.decide(
+                    ci, eng.last_run_telemetry, t_now)
+                if fresh:
+                    self.journal.append(
+                        {"ev": "dispatch_decision",
+                         "bucket": self.bucket.bucket_id,
+                         "decision": dec.to_json()})
+                    if self.metrics is not None:
+                        # the decision also streams as a metrics line
+                        # (obs/metrics.py `decision` kind), same as
+                        # run_controlled — the journal stays the
+                        # replay truth, metrics the observability
+                        self.metrics.emit(
+                            "decision",
+                            label=f"bucket:{self.bucket.bucket_id}",
+                            chunk=dec.chunk,
+                            window_us=dec.window_us,
+                            rung_pin=dec.rung_pin,
+                            chunk_len=dec.chunk_len)
+            chunk_len = dec.chunk_len
+            dyn = eng.dyn_values(dec)
+            if dyn is not None:
+                run_kw["_dyn"] = dyn
+        vec = np.where(active, np.minimum(remaining, chunk_len), 0)
         import time as _time
         from ..interp.jax_engine.common import scan_pad
         from ..obs.profiler import annotate
         _t0 = _time.perf_counter()
         with annotate(f"sweep bucket {self.bucket.bucket_id}"):
-            new_state, traces = eng.run(vec, state=st)
+            new_state, traces = eng.run(vec, state=st, **run_kw)
         chunk_wall = _time.perf_counter() - _t0
         for b in range(B):
             digests[b] = chain_digest(digests[b], traces[b])
@@ -213,6 +284,7 @@ class BucketRunner:
             self.state = new_state
             self.digests = digests
             self.supersteps = supersteps
+            self.chunks = ci + 1
             self.wall_s += chunk_wall
             # utilization bookkeeping: the fleet executed B ×
             # scan_pad(top) superstep bodies for Σ len(traces[b]) real
@@ -236,7 +308,8 @@ class BucketRunner:
                     meta={"bucket": self.bucket.bucket_id,
                           "run_ids": list(self.bucket.run_ids),
                           "digests": list(digests),
-                          "supersteps": [int(s) for s in supersteps]})
+                          "supersteps": [int(s) for s in supersteps],
+                          "chunks": ci + 1})
         return "running"
 
     def utilization(self) -> dict:
@@ -301,13 +374,20 @@ class BucketRunner:
         mid = kids[0].B
         parts = [(kids[0], list(range(mid))),
                  (kids[1], list(range(mid, self.bucket.B)))]
+        # controller buckets: children continue the parent's chunk
+        # numbering from its checkpoint and REPLAY the parent's
+        # decision chain (prior + this process's) — the solo twin's
+        # decision_chain (journal.py) reassembles the same sequence
+        kid_decisions = [d.to_json() for d in self.ctrl.decisions] \
+            if self.ctrl is not None else list(self.prior_decisions)
         runners = []
         for child, idxs in parts:
             r = BucketRunner(child, self.journal, self.done,
                              lint=self.lint, chunk=self.chunk,
                              inject=self.inject,
                              telemetry=self.telemetry,
-                             metrics=self.metrics)
+                             metrics=self.metrics,
+                             prior_decisions=kid_decisions)
             if self.state is not None:
                 idx = np.asarray(idxs)
                 child_state = jax.tree.map(lambda x: x[idx], self.state)
@@ -319,6 +399,7 @@ class BucketRunner:
                           "run_ids": list(child.run_ids),
                           "digests": [self.digests[i] for i in idxs],
                           "supersteps": [self.supersteps[i]
-                                         for i in idxs]})
+                                         for i in idxs],
+                          "chunks": self.chunks})
             runners.append(r)
         return runners

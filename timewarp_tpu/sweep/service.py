@@ -290,9 +290,32 @@ class SweepService:
                 queue.append(BucketRunner(
                     bucket, self.journal, self.done, lint=self.lint,
                     chunk=self.chunk, inject=self.inject,
-                    telemetry=self.telemetry, metrics=self.metrics))
+                    telemetry=self.telemetry, metrics=self.metrics,
+                    # resume replays the journaled dispatch-decision
+                    # chain (split-ancestor prefixes included) so a
+                    # pre-kill decision is never re-made differently
+                    prior_decisions=scan.decision_chain(
+                        bucket.bucket_id)))
         self._planned = len(queue)
         return queue
+
+    def decisions_for_world(self, run_id: str, scan=None):
+        """The journaled dispatch-decision chain governing
+        ``run_id``'s bucket (split ancestry included) — what the
+        ``--verify`` solo twin replays for a controller config, and
+        None for controller-off worlds. Pass a pre-computed
+        ``journal.scan()`` when calling in a loop (the verify path
+        does — re-scanning the whole append-only log per world would
+        be O(worlds × journal)); without one the journal is read
+        fresh, so it works after :meth:`run` returned (or was
+        killed)."""
+        if scan is None:
+            scan = self.journal.scan()
+        bid = scan.world_bucket.get(run_id)
+        if not bid:
+            return None
+        chain = scan.decision_chain(bid)
+        return chain or None
 
     # -- the supervision loop (runs under the asyncio interpreter) -------
 

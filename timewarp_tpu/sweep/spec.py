@@ -76,6 +76,12 @@ class RunConfig:
     window: Any = 1            # int µs or "auto"
     budget: int = 1000
     faults: Optional[str] = None
+    #: online adaptive dispatch (dispatch/, docs/dispatch.md):
+    #: "auto" runs the world's bucket under a telemetry-driven
+    #: controller whose per-chunk decisions are journaled, and the
+    #: survival law's solo twin REPLAYS those decisions (the replay
+    #: law carries the survival law)
+    controller: str = "off"
 
     def __post_init__(self):
         if self.family not in FAMILIES:
@@ -103,6 +109,11 @@ class RunConfig:
             raise SweepConfigError(
                 f"config {self.run_id!r}: window must be an int µs "
                 f">= 1 or 'auto', got {self.window!r}")
+        if self.controller not in ("off", "auto"):
+            raise SweepConfigError(
+                f"config {self.run_id!r}: controller must be 'off' or "
+                f"'auto', got {self.controller!r} (replay is the "
+                "verify path's business, not a pack knob)")
 
     # -- JSON (the pack file / journal form) ------------------------------
 
@@ -112,7 +123,7 @@ class RunConfig:
             raise SweepConfigError(
                 f"pack entry {index} must be a JSON object, got {d!r}")
         known = {"id", "scenario", "params", "link", "seed", "window",
-                 "budget", "faults"}
+                 "budget", "faults", "controller"}
         extra = set(d) - known
         if extra:
             raise SweepConfigError(
@@ -138,6 +149,7 @@ class RunConfig:
             window=d.get("window", 1),
             budget=intf("budget", 1000),
             faults=d.get("faults"),
+            controller=d.get("controller", "off"),
         )
 
     def to_json(self) -> Dict[str, Any]:
@@ -147,6 +159,8 @@ class RunConfig:
                "budget": self.budget}
         if self.faults is not None:
             out["faults"] = self.faults
+        if self.controller != "off":
+            out["controller"] = self.controller
         return out
 
     # -- parsed views ------------------------------------------------------
@@ -195,12 +209,21 @@ class SweepPack:
 
     @classmethod
     def from_json(cls, data: Any) -> "SweepPack":
+        default_ctrl = None
         if isinstance(data, dict):
+            # pack-level controller default: {"controller": "auto",
+            # "worlds": [...]} turns the knob on for every config that
+            # does not say otherwise (explicit per-config wins)
+            default_ctrl = data.get("controller")
             data = data.get("worlds", data)
         if not isinstance(data, list):
             raise SweepConfigError(
                 "a pack file is a JSON list of config objects (or "
                 "{'worlds': [...]})")
+        if default_ctrl is not None:
+            data = [({**d, "controller": default_ctrl}
+                     if isinstance(d, dict) and "controller" not in d
+                     else d) for d in data]
         return cls(tuple(RunConfig.from_json(d, i)
                          for i, d in enumerate(data)))
 
@@ -316,29 +339,51 @@ def link_sweep_params(link, prefix: str = "") -> Dict[str, Any]:
 def resolve_window(cfg: RunConfig) -> int:
     """The window a solo run of ``cfg`` resolves (JaxEngine.__init__
     order: the link floor, degraded by the config's own fault
-    schedule, then "auto" -> max(1, floor)). Buckets key on this so
-    the batched engine runs exactly the window every member's solo
-    twin would."""
+    schedule, then "auto" -> max(1, floor), int32-clamped). Buckets
+    key on this so the batched engine runs exactly the window every
+    member's solo twin would. Controller configs resolve the dynamic
+    window's BOUND instead — the UNDEGRADED floor, exactly as the
+    engine does (degradation clamps on-device per superstep,
+    docs/dispatch.md)."""
+    from ..interp.jax_engine.common import I32MAX
     link = cfg.parse_link()
     floor = link.min_delay_us
     sched = cfg.parse_faults()
-    if sched is not None:
+    if sched is not None and cfg.controller == "off":
         floor = sched.min_delay_floor(floor)
     if cfg.window == "auto":
-        return max(1, int(floor))
+        return max(1, min(int(floor), I32MAX - 1))
     return int(cfg.window)
 
 
 # -- the solo (law right-hand-side) run ------------------------------------
 
-def solo_engine(cfg: RunConfig, *, lint: str = "warn"):
+def solo_engine(cfg: RunConfig, *, lint: str = "warn",
+                decisions=None):
     """The standalone engine for one config — what the sweep's
-    streamed result must be bit-identical to."""
+    streamed result must be bit-identical to. Controller configs take
+    the bucket's journaled ``decisions`` (dispatch_decision records)
+    and get a REPLAY controller: the replay law (dispatch/) then
+    carries the survival law — the solo twin re-applies exactly the
+    chunking/window/rung sequence the bucket decided."""
     from ..interp.jax_engine.engine import JaxEngine
     sc = build_scenario(cfg.family, cfg.params)
+    controller = None
+    if cfg.controller == "auto":
+        if decisions is None:
+            raise SweepConfigError(
+                f"config {cfg.run_id!r} runs under a dispatch "
+                "controller; its solo twin needs the journaled "
+                "decision records (sweep journal dispatch_decision "
+                "events) — an auto solo run would decide its own "
+                "chunking and legitimately diverge")
+        from ..dispatch import DispatchController
+        controller = DispatchController(mode="replay",
+                                        replay=decisions)
     return JaxEngine(sc, cfg.parse_link(), seed=cfg.seed,
                      window=resolve_window(cfg),
-                     faults=cfg.parse_faults(), lint=lint)
+                     faults=cfg.parse_faults(), lint=lint,
+                     controller=controller)
 
 
 #: the digest chain seed (hex of 32 zero bytes)
@@ -389,11 +434,17 @@ def world_result(cfg: RunConfig, state, b: Optional[int],
     return out
 
 
-def solo_result(cfg: RunConfig, *, lint: str = "warn") -> Dict[str, Any]:
+def solo_result(cfg: RunConfig, *, lint: str = "warn",
+                decisions=None) -> Dict[str, Any]:
     """Run ``cfg`` standalone and produce the exact record the sweep
     journal would stream for it — the right-hand side of the sweep
-    survival law (tests/test_zsweep.py; the bench and CI smoke gates)."""
-    eng = solo_engine(cfg, lint=lint)
-    final, trace = eng.run(cfg.budget)
+    survival law (tests/test_zsweep.py; the bench and CI smoke gates).
+    Controller configs replay the bucket's journaled ``decisions``
+    (see :func:`solo_engine`)."""
+    eng = solo_engine(cfg, lint=lint, decisions=decisions)
+    if cfg.controller == "auto":
+        final, trace = eng.run_controlled(cfg.budget)
+    else:
+        final, trace = eng.run(cfg.budget)
     return world_result(cfg, final, None,
                         chain_digest(DIGEST_ZERO, trace), len(trace))
