@@ -1007,6 +1007,88 @@ def bench_praos_1m_fused(n, steps):
             delivered / dt)
 
 
+def _verify_detection_gate(make_engine, budget=64, chunk=8):
+    """The detection law, in-bench (integrity/, ISSUE 10 acceptance):
+    one seeded flip injected between chunks of a digest-mode run must
+    be DETECTED (>= 1 rollback) and the recovered run bit-identical —
+    states, traces, digest chain — to a clean run. Runs before any
+    measured number counts, like every other in-bench gate."""
+    from timewarp_tpu.integrity import FlipInjector
+    from timewarp_tpu.trace.events import (assert_states_equal,
+                                           assert_traces_equal)
+    clean = make_engine("digest")
+    fc, tc = clean.run_verified(budget, chunk=chunk)
+    injected = make_engine("digest")
+    inj = FlipInjector("flip:7:2")
+    fi, ti = injected.run_verified(budget, chunk=chunk, inject=inj)
+    assert inj.fired, "flip never fired (fewer than 2 chunks ran)"
+    assert injected.last_run_integrity["rollbacks"] >= 1, \
+        "injected flip went UNDETECTED (the detection law is broken)"
+    assert_traces_equal(tc, ti, "clean", "recovered")
+    assert_states_equal(fc, fi, "in-bench detection-law gate")
+    assert clean.last_run_stats["digest_chain"] \
+        == injected.last_run_stats["digest_chain"], \
+        "recovered digest chain diverged from the clean run's"
+
+
+def bench_gossip_100k_verify(n, steps):
+    """Self-verifying execution (integrity/, docs/integrity.md): the
+    gossip wave through the verified chunked driver under every
+    verify mode, reporting ``verify_overhead_frac`` per mode vs the
+    same driver with verify off. Gated in-bench by the detection law
+    (one injected flip -> detected + bit-exact recovery) and by the
+    digest-mode overhead budget: <= 10% strict on a chip-attached
+    round; on CPU/smoke the run-to-run noise dwarfs the budget, so
+    the bound loosens to a 2x catastrophic-regression check and the
+    measured fractions ride the JSON line for the record (the same
+    convention as the telemetry gate)."""
+    import statistics
+
+    from timewarp_tpu.interp.jax_engine.engine import JaxEngine
+
+    n = n or 100_000
+    sc, link = _gossip_wave(n)
+
+    def make(mode):
+        return JaxEngine(sc, link, window="auto", lint="off",
+                         verify=mode)
+
+    _verify_detection_gate(make)
+    budget = steps or (1 << 20)
+    chunk = 256
+
+    def med(mode, reps=2):
+        eng = make(mode)
+        eng.run_verified(budget, chunk=chunk)   # warm the compiles
+        walls = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            fin, _tr = eng.run_verified(budget, chunk=chunk)
+            walls.append(time.perf_counter() - t0)
+        return statistics.median(walls), fin, eng
+
+    w_off, fin, eng_off = med("off")
+    _assert_wave_done(eng_off, fin, n)
+    import numpy as np
+    delivered = int(np.asarray(jax.device_get(fin.delivered)).sum())
+    overheads = {}
+    for mode in ("guard", "digest", "shadow"):
+        w_mode, fin_m, eng_m = med(mode)
+        assert eng_m.last_run_integrity["rollbacks"] == 0, \
+            f"verify={mode} false positive on a clean run"
+        overheads[mode] = round(w_mode / w_off - 1.0, 4)
+    strict = jax.default_backend() == "tpu" and not _SMOKE
+    limit = 0.10 if strict else 1.0
+    assert overheads["digest"] <= limit, (
+        f"verify='digest' costs {overheads['digest']:.1%} — over the "
+        f"{limit:.0%} budget (integrity/ overhead contract; chip "
+        "re-run owed for the strict bound)")
+    return (f"gossip broadcast wave to quiescence (verified chunked "
+            f"driver, verify=off) delivered-messages/sec/chip "
+            f"@{n} nodes", delivered / w_off,
+            {"verify_overhead_frac": overheads})
+
+
 CONFIGS = {
     "token_ring_dense": bench_token_ring_dense,
     "token_ring_dense_xla": bench_token_ring_dense_xla,
@@ -1017,6 +1099,7 @@ CONFIGS = {
     "gossip_100k_b8": bench_gossip_100k_b8,
     "gossip_100k_chaos": bench_gossip_100k_chaos,
     "gossip_100k_auto": bench_gossip_100k_auto,
+    "gossip_100k_verify": bench_gossip_100k_verify,
     "gossip_steady_1m": bench_gossip_steady_1m,
     "praos_1m": bench_praos_1m,
     "praos_1m_fused": bench_praos_1m_fused,
@@ -1039,6 +1122,7 @@ SMOKE = {
     "gossip_100k_b8": (1024, 1 << 14),
     "gossip_100k_chaos": (1024, 1 << 14),
     "gossip_100k_auto": (1024, 1 << 14),
+    "gossip_100k_verify": (1024, 1 << 14),
     "gossip_steady_1m": (4096, 16),
     "praos_1m": (2048, 24),
     "praos_1m_fused": (2048, 24),

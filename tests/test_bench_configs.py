@@ -18,7 +18,7 @@ def test_bench_config_runs(cfg):
          "gossip_100k": 512, "gossip_100k_fused": 2048,
          "gossip_100k_insert": 2048,
          "gossip_100k_b8": 512, "gossip_100k_chaos": 512,
-         "gossip_100k_auto": 512,
+         "gossip_100k_auto": 512, "gossip_100k_verify": 512,
          "gossip_steady_1m": 512,
          "praos_1m": 512, "praos_1m_fused": 2048,
          "praos_1m_insert": 2048,

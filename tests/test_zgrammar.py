@@ -120,3 +120,101 @@ def test_good_specs_still_parse():
         "crash:3:5s:9s:reset; partition:0-3|4-7:2s:4s; "
         "degrade:all:all:1s:2s:4.0:10ms; skew:2:250")
     assert len(sched.events) == 4
+
+
+# ---------------------------------------------------------------------------
+# the --inject flip: grammar (integrity/, ISSUE 10 satellite)
+# ---------------------------------------------------------------------------
+
+BAD_INJECTS = [
+    "flip",                      # no seed
+    "flip:",                     # empty seed
+    "flip:x",                    # non-numeric seed
+    "flip:-1",                   # negative seed
+    "flip:1:0",                  # chunk must be >= 1
+    "flip:1:z",                  # non-numeric chunk
+    "flip:1:2:",                 # empty plane
+    "flip:1:2:mb_rel:extra",     # excess fields
+    "flip:1.5",                  # float seed
+]
+
+
+@pytest.mark.parametrize("spec", BAD_INJECTS)
+def test_malformed_flip_specs_name_the_grammar(spec):
+    from timewarp_tpu.integrity.inject import INJECT_GRAMMAR
+    from timewarp_tpu.sweep.service import InjectPlan
+    from timewarp_tpu.sweep.spec import SweepConfigError
+    with pytest.raises(SweepConfigError) as ei:
+        InjectPlan(spec)
+    msg = str(ei.value)
+    assert "grammar" in msg and INJECT_GRAMMAR in msg, \
+        f"{spec!r} died without naming INJECT_GRAMMAR: {msg}"
+
+
+@pytest.mark.parametrize("spec", BAD_INJECTS)
+def test_malformed_flip_specs_never_raw_traceback(spec):
+    from timewarp_tpu.sweep.service import InjectPlan
+    from timewarp_tpu.sweep.spec import SweepConfigError
+    try:
+        InjectPlan(spec)
+    except SweepConfigError:
+        pass
+    else:
+        pytest.fail(f"{spec!r} parsed without error")
+
+
+def test_good_flip_specs_parse():
+    from timewarp_tpu.integrity.inject import FlipSpec, parse_flip
+    from timewarp_tpu.sweep.service import InjectPlan
+    assert parse_flip("flip:3") == FlipSpec(seed=3, chunk=1,
+                                            plane=None)
+    assert parse_flip("flip:3:7") == FlipSpec(seed=3, chunk=7,
+                                              plane=None)
+    assert parse_flip("flip:3:7:mb_rel") == FlipSpec(
+        seed=3, chunk=7, plane="mb_rel")
+    plan = InjectPlan("fail:1;flip:5:2:mb_rel;die:9")
+    assert plan.flip[2].seed == 5 and plan.flip[2].plane == "mb_rel"
+    assert plan.fail == {1} and plan.die == {9}
+
+
+# ---------------------------------------------------------------------------
+# parse round-trip idempotence (ISSUE 10 satellite): parsing the same
+# spec twice yields the SAME model — field-equal objects AND (for
+# faults) bit-identical lowered tables. A parser with hidden state
+# (mutating defaults, shared caches, entropy) would break the sweep
+# bucketer's link_signature identity and the resume path's
+# re-derivation of the same plan from the journaled pack.
+# ---------------------------------------------------------------------------
+
+GOOD_LINKS = [
+    "fixed:500",
+    "uniform:1000:5000",
+    "lognormal:5000:0.5",
+    "never",
+    "drop:0.25:quantize:1000:uniform:1000:5000",
+    "quantize:1000:lognormal:5000:0.5",
+]
+
+GOOD_FAULTS = [
+    "crash:3:5s:9s",
+    "crash:3:5s:9s:reset",
+    "partition:0-3|4-7:2s:4s",
+    "degrade:all:all:1s:2s:4.0:10ms",
+    "skew:2:250",
+    "crash:1:2s:3s; partition:0-1|2-3:1s:2s; "
+    "degrade:all:all:1s:2s:2.0; skew:0:100",
+]
+
+
+@pytest.mark.parametrize("spec", GOOD_LINKS)
+def test_parse_link_round_trip_idempotent(spec):
+    assert parse_link(spec) == parse_link(spec)
+
+
+@pytest.mark.parametrize("spec", GOOD_FAULTS)
+def test_parse_faults_round_trip_idempotent(spec):
+    import numpy as np
+    a, b = parse_faults(spec), parse_faults(spec)
+    assert a == b
+    ta, tb = a.tables(8), b.tables(8)
+    assert all(np.array_equal(x, y) for x, y in zip(ta, tb))

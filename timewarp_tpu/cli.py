@@ -199,6 +199,7 @@ def build_engine(args, sc, link):
     batch = build_batch(args)
     faults = build_faults(args)
     telemetry = getattr(args, "telemetry", "off")
+    verify = getattr(args, "verify", "off")
     controller = build_controller(args)
     if controller is not None \
             and args.engine not in CONTROLLER_ENGINES:
@@ -288,7 +289,8 @@ def build_engine(args, sc, link):
                          telemetry=telemetry,
                          insert=getattr(args, "insert", None),
                          insert_cap=getattr(args, "insert_cap", None),
-                         controller=controller)
+                         controller=controller,
+                         verify=verify)
     if args.engine == "sharded-batched":
         from .interp.jax_engine.sharded import (ShardedBatchedEngine,
                                                 make_mesh)
@@ -296,7 +298,8 @@ def build_engine(args, sc, link):
             sc, link, make_mesh(args.devices, axis="worlds"),
             batch=batch, seed=args.seed, window=args.window,
             route_cap=args.route_cap, lint=args.lint, faults=faults,
-            telemetry=telemetry, controller=controller)
+            telemetry=telemetry, controller=controller,
+            verify=verify)
     if args.engine == "fused-sparse":
         from .interp.jax_engine.fused_sparse import FusedSparseEngine
         kw = {} if args.max_batch is None else {
@@ -305,12 +308,15 @@ def build_engine(args, sc, link):
                                  window=args.window,
                                  record_events=args.record_events,
                                  lint=args.lint, telemetry=telemetry,
-                                 controller=controller, **kw)
+                                 controller=controller,
+                                 verify=verify,
+                                 **kw)
     if args.engine == "edge":
         from .interp.jax_engine.edge_engine import EdgeEngine
         return EdgeEngine(sc, link, seed=args.seed, cap=args.edge_cap,
                           lint=args.lint, faults=faults,
-                          telemetry=telemetry, controller=controller)
+                          telemetry=telemetry, controller=controller,
+                          verify=verify)
     if args.engine in ("sharded", "sharded-edge", "sharded-fused"):
         from .interp.jax_engine.sharded import (
             ShardedEdgeEngine, ShardedEngine,
@@ -320,15 +326,18 @@ def build_engine(args, sc, link):
             return ShardedEdgeEngine(sc, link, mesh, seed=args.seed,
                                      cap=args.edge_cap,
                                      lint=args.lint,
-                                     telemetry=telemetry)
+                                     telemetry=telemetry,
+                                     verify=verify)
         if args.engine == "sharded-fused":
             return ShardedFusedSparseEngine(
                 sc, link, mesh, seed=args.seed, window=args.window,
-                lint=args.lint, telemetry=telemetry)
+                lint=args.lint, telemetry=telemetry,
+                verify=verify)
         return ShardedEngine(sc, link, mesh, seed=args.seed,
                              window=args.window,
                              route_cap=args.route_cap,
-                             lint=args.lint, telemetry=telemetry)
+                             lint=args.lint, telemetry=telemetry,
+                             verify=verify)
     raise SystemExit(f"unknown engine {args.engine!r}")
 
 
@@ -613,6 +622,32 @@ def main(argv=None) -> int:
                         "writing to this log dir (view with xprof/"
                         "TensorBoard); degrades to a warning when "
                         "profiling is unavailable")
+    p.add_argument("--verify", default="off",
+                   choices=["off", "guard", "digest", "shadow"],
+                   help="online state-integrity checking (integrity/, "
+                        "docs/integrity.md): guard = on-device "
+                        "invariant checks in the traced scan (loud "
+                        "IntegrityViolation naming the first "
+                        "violating superstep + field); digest = + "
+                        "per-chunk rolling state digest with "
+                        "deterministic rollback recovery; shadow = + "
+                        "sampled re-execution through the pow2-cache "
+                        "twin executable. 'off' lowers to the exact "
+                        "verify-free program")
+    p.add_argument("--verify-chunk", type=int, default=None,
+                   help="supersteps per verified chunk, default 64 "
+                        "(--verify digest|shadow)")
+    p.add_argument("--verify-cadence", type=int, default=None,
+                   help="shadow-sample every Nth chunk for "
+                        "re-execution, default 1 (--verify shadow; "
+                        "the cheap digest entry check runs every "
+                        "chunk)")
+    p.add_argument("--inject-flip", default=None,
+                   help="deterministic state corruption for testing "
+                        "the detection law: flip:SEED[:CHUNK[:PLANE]] "
+                        "— a seeded bit-flip written into a state "
+                        "plane between chunks (needs --verify; "
+                        "docs/integrity.md)")
     args = p.parse_args(argv)
     if args.telemetry == "off" and (args.metrics_out or args.trace_out):
         raise SystemExit(
@@ -631,6 +666,57 @@ def main(argv=None) -> int:
         raise SystemExit(
             "--controller drives the jitted chunked engines; the "
             "host oracle has no compiled chunks to adapt")
+    if args.verify != "off" and args.engine == "oracle":
+        raise SystemExit(
+            "--verify checks the jitted engines' device state; the "
+            "host oracle's state is host Python (cross-check it "
+            "against an engine via the parity law instead — "
+            "docs/integrity.md)")
+    if args.inject_flip and args.verify not in ("digest", "shadow"):
+        # the guard must live HERE, not in the run branch: a
+        # controller run takes run_controlled and would otherwise
+        # silently never apply the flip — the user's detection-law
+        # test would test nothing
+        raise SystemExit(
+            "--inject-flip corrupts state BETWEEN chunks (the "
+            "verified driver's window); pass --verify digest|shadow "
+            "— off/guard runs would leave the flip UNDETECTED (or "
+            "never applied) by design (docs/integrity.md)")
+    if args.verify in ("digest", "shadow") and args.controller != "off":
+        raise SystemExit(
+            "--verify digest|shadow runs the verified chunked driver "
+            "(run_verified); --controller runs the adaptive one — "
+            "combine them via the sweep service (--state-verify, "
+            "docs/integrity.md). --verify guard rides any driver")
+    if args.verify_chunk is not None \
+            and args.verify not in ("digest", "shadow"):
+        raise SystemExit(
+            "--verify-chunk shapes the verified chunked driver; "
+            "pass --verify digest|shadow (guard/off runs are "
+            "unchunked — the knob would be silently ignored)")
+    if args.verify_cadence is not None and args.verify != "shadow":
+        raise SystemExit(
+            "--verify-cadence samples chunks for shadow "
+            "re-execution; pass --verify shadow (the digest entry "
+            "check runs every chunk regardless — the knob would be "
+            "silently ignored)")
+    if args.verify_chunk is not None and args.verify_chunk < 1:
+        raise SystemExit(
+            f"--verify-chunk must be >= 1, got {args.verify_chunk}")
+    if args.verify_cadence is not None and args.verify_cadence < 1:
+        raise SystemExit(
+            f"--verify-cadence must be >= 1, got {args.verify_cadence}")
+    flip_inj = None
+    if args.inject_flip:
+        # parse WITH the other argument guards: a malformed spec must
+        # die as a grammar-named clean exit before any engine builds,
+        # never a raw mid-run ValueError traceback (the loud-grammar
+        # contract, tests/test_zgrammar.py)
+        from .integrity import FlipInjector
+        try:
+            flip_inj = FlipInjector(args.inject_flip)
+        except ValueError as e:
+            raise SystemExit(str(e)) from None
 
     from .utils.logconfig import load_log_config
     load_log_config(args.log_config)
@@ -697,6 +783,22 @@ def main(argv=None) -> int:
             if engine.controller is not None:
                 final, trace = engine.run_controlled(args.steps,
                                                      state=state)
+            elif args.verify in ("digest", "shadow"):
+                # the self-verifying chunked driver (integrity/,
+                # docs/integrity.md): per-chunk digest / shadow
+                # checks with deterministic rollback recovery —
+                # guard mode needs no special driver (the invariant
+                # plane rides any traced run and raises loudly).
+                # Explicit None checks: `or` would silently rewrite
+                # an (invalid) 0 instead of letting run_verified's
+                # own >= 1 guard refuse it
+                final, trace = engine.run_verified(
+                    args.steps, state=state,
+                    chunk=(64 if args.verify_chunk is None
+                           else args.verify_chunk),
+                    cadence=(1 if args.verify_cadence is None
+                             else args.verify_cadence),
+                    inject=flip_inj)
             else:
                 final, trace = engine.run(args.steps, state=state)
         if args.save:
@@ -773,6 +875,20 @@ def main(argv=None) -> int:
                    **final_info}
     if args.telemetry != "off":
         summary.update(_export_telemetry(args, sc, engine, trace))
+    if args.verify != "off":
+        ri = getattr(engine, "last_run_integrity", None)
+        summary["integrity"] = {"mode": args.verify} if ri is None \
+            else {"mode": ri["mode"], "chunks": ri["chunks"],
+                  "checks": ri["checks"],
+                  "rollbacks": ri["rollbacks"],
+                  "violations": len(ri["violations"]),
+                  "digest_chain": ri["digest_chain"]}
+        if flip_inj is not None:
+            # the detection law's receipt: the flip fired AND the
+            # run rolled back (a fired flip with zero rollbacks is a
+            # detection failure — CI greps for this)
+            summary["integrity"]["flip_fired"] = flip_inj.fired
+            summary["integrity"]["flip"] = flip_inj.desc
     if getattr(engine, "controller", None) is not None:
         decs = engine.last_run_decisions or []
         summary["controller"] = {

@@ -1,0 +1,66 @@
+"""Self-verifying execution: online state-integrity checking with
+deterministic rollback recovery (docs/integrity.md).
+
+The repo's exactness laws (engine ≡ oracle, strategy ≡ strategy,
+world-slice ≡ solo, controller ≡ replay) are *design-time* guarantees;
+this package is what uses them at **run time**. Every scan-driver
+engine grows a ``verify=`` knob — ``"off" | "guard" | "digest" |
+"shadow"``, an escalating ladder with the telemetry subsystem's
+zero-overhead-when-off contract (the off-mode jaxpr is byte-identical
+to the pre-knob engine):
+
+- ``"guard"`` — fixed-shape on-device invariant checks threaded
+  through the traced scan (checks.py): virtual time monotone
+  non-decreasing, no negative never-silent counter, wake/mailbox
+  entries never in the past (unfaulted runs), the ``restart_done``
+  ledger monotone against the fault tables. The first violating
+  superstep + field surfaces in the pinned TraceMismatch-style
+  diagnostic format (:class:`IntegrityViolation`).
+- ``"digest"`` — guard, plus a cheap fixed-shape rolling digest of the
+  whole engine state per chunk on-device (digest.py), recomputed at
+  every chunk *entry*: a bit flipped in HBM (or a checkpoint restored
+  corrupt) between chunks changes the digest and is detected within
+  the configured cadence. The digest chains through
+  ``last_run_stats`` / the metrics stream, and extends the sweep
+  checkpoints' sha256 digest chain so every checkpoint marks a
+  *verified epoch*.
+- ``"shadow"`` — digest, plus an SDC cross-check: deterministically
+  sampled chunks re-execute through a second already-compiled
+  executable (the pow2-cache twin — same semantics, different
+  compiled program) and the resulting state digests must agree. By
+  the exactness laws any disagreement is hardware corruption or a
+  real bug — never silent either way.
+
+On detection, recovery is **deterministic rollback** (runner.py
+:meth:`VerifiedRunMixin.run_verified`): restore the last verified
+snapshot, discard the tainted trace rows, and re-run — the recovered
+run is bit-identical to an uninjected run (the detection law,
+tests/test_zzzzintegrity.py). The sweep service's flavor rides its
+existing machinery: a violation journals an ``integrity_violation``
+event and retries the affected bucket from its last verified
+checkpoint, replaying the journaled dispatch-decision chain
+(sweep/runner.py) — rollback of just that bucket, not the sweep.
+
+Testing the machinery is deterministic too: the ``--inject`` chaos
+grammar grows ``flip:SEED[:CHUNK[:PLANE]]`` (inject.py) — a seeded
+bit-flip written into a state plane between chunks.
+"""
+
+from .checks import (VERIFY_MODES, IntegrityRow, IntegrityViolation,
+                     first_guard_violation, make_guard_row,
+                     validate_verify)
+from .digest import (VERIFY_CHAIN_ZERO, chain_state_digest,
+                     host_digests, tree_digest)
+from .inject import (INJECT_GRAMMAR, FlipInjector, FlipSpec,
+                     apply_flip, parse_flip)
+from .runner import VerifiedRunMixin
+
+__all__ = [
+    "VERIFY_MODES", "IntegrityRow", "IntegrityViolation",
+    "first_guard_violation", "make_guard_row", "validate_verify",
+    "VERIFY_CHAIN_ZERO", "chain_state_digest", "host_digests",
+    "tree_digest",
+    "INJECT_GRAMMAR", "FlipInjector", "FlipSpec", "apply_flip",
+    "parse_flip",
+    "VerifiedRunMixin",
+]

@@ -1,0 +1,147 @@
+"""Rolling on-device state digests and their sha256 chain.
+
+``tree_digest`` folds a complete engine-state pytree (EngineState,
+EdgeState, or any NamedTuple-of-arrays) into ONE uint32 on-device —
+a fixed-shape reduction built from the trace subsystem's own mix
+(trace/hashing.py ``mix32_jnp`` + the wrapping uint32 sum), tagged
+per leaf and per element index so a moved value hashes differently
+from a changed one. Cost is one elementwise pass over the state —
+what makes the ``digest`` verify mode's ≤10% overhead budget
+realistic (bench.py ``gossip_100k_verify``).
+
+The host side chains digests exactly the way the sweep chains trace
+digests (sweep/spec.py ``chain_digest``): ``chain' = sha256(chain ||
+digest)``, hex in / hex out, so a chunked, checkpointed, killed and
+resumed run lands on the same chain value one uninterrupted run
+computes — every sweep checkpoint whose meta carries the chain is a
+*verified epoch* (sweep/runner.py).
+
+Detection model: the digest is recomputed at every chunk **entry**
+(runner.py) and compared against the value recorded at the previous
+chunk's exit. The state arrays did not legitimately change in
+between — so any difference is corruption of state at rest (an HBM
+flip, a bad checkpoint restore), detected within the configured
+cadence, before the corrupt state executes a single superstep.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+import numpy as np
+
+__all__ = ["tree_digest", "fleet_digest", "host_digests",
+           "VERIFY_CHAIN_ZERO", "chain_state_digest",
+           "first_digest_mismatch"]
+
+
+def first_digest_mismatch(got, want):
+    """First world index whose digest moved, or None — the ONE
+    compare idiom every digest check site uses (engine entry check,
+    snapshot re-check, sweep prepare/step), with both values
+    pre-formatted for the diagnostic. Returns ``(index, got_hex,
+    want_hex)``."""
+    g = np.asarray(got, np.uint32)
+    w = np.asarray(want, np.uint32)
+    bad = np.nonzero(g != w)[0]
+    if bad.size == 0:
+        return None
+    b = int(bad[0])
+    return b, f"{int(g[b]):08x}", f"{int(w[b]):08x}"
+
+#: the state-digest chain seed (hex of 32 zero bytes — the same seed
+#: convention as sweep/spec.py DIGEST_ZERO)
+VERIFY_CHAIN_ZERO = "0" * 64
+
+
+def _leaf_words(x):
+    """A leaf as one or two flat uint32 word vectors (bit-exact:
+    int64 splits into lo/hi words, 32-bit dtypes bitcast, sub-32-bit
+    dtypes widen losslessly)."""
+    import jax
+    import jax.numpy as jnp
+    from ..ops.numeric import thi, tlo
+    x = jnp.asarray(x)
+    if x.dtype == jnp.bool_:
+        return (x.reshape(-1).astype(jnp.uint32),)
+    if x.dtype.itemsize == 8:
+        if x.dtype != jnp.int64:
+            x = jax.lax.bitcast_convert_type(x, jnp.int64)
+        f = x.reshape(-1)
+        return (tlo(f), thi(f))
+    if x.dtype.itemsize == 4:
+        if x.dtype != jnp.uint32:
+            x = jax.lax.bitcast_convert_type(x, jnp.uint32)
+        return (x.reshape(-1),)
+    # 8/16-bit leaves (none in the shipped states, but scenario state
+    # pytrees are user-defined): widen via a uint8 view — lossless
+    return (jax.lax.bitcast_convert_type(
+        x, jnp.uint8).reshape(-1).astype(jnp.uint32),)
+
+
+def _tree_digest(state):
+    import jax
+    import jax.numpy as jnp
+    from ..ops.numeric import u32sum
+    from ..trace.hashing import mix32_jnp
+    h = jnp.uint32(0x811C9DC5)
+    for i, leaf in enumerate(jax.tree.leaves(state)):
+        for j, w in enumerate(_leaf_words(leaf)):
+            if w.shape[0] == 0:
+                continue
+            idx = jnp.arange(w.shape[0], dtype=jnp.uint32)
+            lh = u32sum(mix32_jnp(jnp.uint32(0xD1D0 + i),
+                                  jnp.uint32(j), idx, w))
+            # order-dependent fold across leaves/words: leaf identity
+            # is in the tag, word position in this chain
+            h = mix32_jnp(h, lh)
+    return h
+
+
+#: memoized jitted digest programs: jit caches on FUNCTION IDENTITY,
+#: so building `jax.jit(jax.vmap(_tree_digest))` per call would hand
+#: the cache a fresh vmap object every time and retrace at every
+#: chunk boundary (~2500x the cached cost) — the wrappers are built
+#: once, lazily (jax stays an in-function import like the rest of
+#: this package)
+_JITTED: dict = {}
+
+
+def tree_digest(state):
+    """One uint32 digest of a whole (solo) state pytree, jitted —
+    cached per treedef/shape like any jitted program."""
+    fn = _JITTED.get("solo")
+    if fn is None:
+        import jax
+        fn = _JITTED["solo"] = jax.jit(_tree_digest)
+    return fn(state)
+
+
+def fleet_digest(state):
+    """Per-world digests of a batched state (leading world axis on
+    every leaf): uint32[B]."""
+    fn = _JITTED.get("fleet")
+    if fn is None:
+        import jax
+        fn = _JITTED["fleet"] = jax.jit(jax.vmap(_tree_digest))
+    return fn(state)
+
+
+def host_digests(state, batch=None) -> np.ndarray:
+    """The host-side view every verified driver uses: uint32[1] for a
+    solo state, uint32[B] for a batched one (``batch`` is the
+    engine's BatchSpec or None)."""
+    import jax
+    if batch is None:
+        return np.asarray([jax.device_get(tree_digest(state))],
+                          np.uint32)
+    return np.asarray(jax.device_get(fleet_digest(state)), np.uint32)
+
+
+def chain_state_digest(prev_hex: str, digest) -> str:
+    """Fold one uint32 state digest into a running sha256 chain (hex
+    in, hex out) — the incremental form that survives chunking,
+    checkpoints, and resume (module docstring)."""
+    return hashlib.sha256(
+        bytes.fromhex(prev_hex)
+        + int(digest).to_bytes(4, "little")).hexdigest()

@@ -1,0 +1,153 @@
+"""Deterministic state corruption: the ``flip:`` chaos grammar.
+
+The PR 5 sweep-machinery chaos grammar (``--inject fail:K | oom:K |
+die:K | hang:K:MS``, sweep/service.py) grows a fourth form::
+
+    flip:SEED[:CHUNK[:PLANE]]
+
+— a **seeded bit-flip written into a state plane between chunks**,
+the test/CI lever the detection law is pinned against
+(tests/test_zzzzintegrity.py): every injected flip must be detected
+within the configured verify cadence, and the rolled-back run must be
+bit-identical to an uninjected run. ``SEED`` keys the element and bit
+choice, ``CHUNK`` (1-based, default 1) picks the chunk boundary the
+flip lands on, ``PLANE`` names a state field (``mb_rel``, ``wake``,
+``delivered``, ``states.<leaf>``, …; default seed-chosen among the
+non-empty planes).
+
+The flip is applied host-side between chunks — exactly the window the
+``digest`` verify mode's entry check covers — and each spec fires
+once (rollback re-runs the same chunk index; the injector must not
+re-corrupt the recovered state, or no recovery could ever converge).
+
+Malformed specs die naming :data:`INJECT_GRAMMAR`, never a raw
+traceback (tests/test_zgrammar.py) — the same loud-grammar contract
+as LINK_GRAMMAR / FAULT_GRAMMAR.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+import numpy as np
+
+__all__ = ["INJECT_GRAMMAR", "FlipSpec", "parse_flip", "apply_flip",
+           "FlipInjector"]
+
+#: the flip form of the sweep --inject grammar (sweep/service.py
+#: InjectPlan carries the full four-form grammar string)
+INJECT_GRAMMAR = ("flip:SEED[:CHUNK[:PLANE]]  (seeded bit-flip "
+                  "written into a state plane before chunk CHUNK "
+                  "(1-based, default 1); PLANE = a state field name, "
+                  "default seed-chosen)")
+
+
+@dataclass(frozen=True)
+class FlipSpec:
+    seed: int
+    chunk: int = 1
+    plane: Optional[str] = None
+
+
+def parse_flip(part: str) -> FlipSpec:
+    """Parse one ``flip:...`` spec; raises ``ValueError`` naming
+    INJECT_GRAMMAR on any malformation (the sweep's InjectPlan
+    re-raises it as a SweepConfigError; an embedding caller gets a
+    catchable error either way)."""
+    bits = part.split(":")
+    try:
+        if bits[0] != "flip" or not 2 <= len(bits) <= 4:
+            raise ValueError(part)
+        seed = int(bits[1])
+        chunk = int(bits[2]) if len(bits) >= 3 else 1
+        plane = bits[3] if len(bits) == 4 else None
+        if seed < 0 or chunk < 1 or (plane is not None and not plane):
+            raise ValueError(part)
+        return FlipSpec(seed=seed, chunk=chunk, plane=plane)
+    except (IndexError, ValueError):
+        raise ValueError(
+            f"malformed flip spec {part!r}; grammar: "
+            f"{INJECT_GRAMMAR}") from None
+
+
+def _leaf_names(state) -> Tuple[list, list, object]:
+    """Flatten a state pytree with dotted path names (``mb_rel``,
+    ``states.cnt``, …) — what ``PLANE`` matches against."""
+    import jax
+    path_leaves, treedef = jax.tree_util.tree_flatten_with_path(state)
+
+    def name(path) -> str:
+        parts = []
+        for k in path:
+            if hasattr(k, "name"):
+                parts.append(str(k.name))
+            elif hasattr(k, "key"):
+                parts.append(str(k.key))
+            elif hasattr(k, "idx"):
+                parts.append(str(k.idx))
+            else:
+                parts.append(str(k))
+        return ".".join(parts)
+    names = [name(p) for p, _ in path_leaves]
+    leaves = [x for _, x in path_leaves]
+    return names, leaves, treedef
+
+
+def apply_flip(state, seed: int, plane: Optional[str] = None):
+    """Flip one seeded bit (or invert one seeded bool) in one leaf of
+    ``state``; returns ``(corrupted_state, description)``. Pure: the
+    input pytree is untouched (arrays are copied before the flip), so
+    a caller's snapshot of the clean state stays clean — which is
+    exactly what makes rollback recovery testable."""
+    import jax
+    names, leaves, treedef = _leaf_names(state)
+    rng = np.random.default_rng(seed)
+    eligible = [i for i, x in enumerate(leaves) if np.asarray(
+        jax.device_get(x)).size > 0]
+    if not eligible:
+        raise ValueError("state has no non-empty plane to flip")
+    if plane is not None:
+        cand = [i for i in eligible
+                if names[i] == plane or names[i].endswith("." + plane)]
+        if not cand:
+            raise ValueError(
+                f"flip plane {plane!r} names no non-empty state "
+                f"field; available: {[names[i] for i in eligible]}")
+        li = cand[0]
+    else:
+        li = eligible[int(rng.integers(len(eligible)))]
+    arr = np.array(jax.device_get(leaves[li]))  # a copy — pure
+    flat = arr.reshape(-1)
+    ei = int(rng.integers(flat.size))
+    if arr.dtype == bool:
+        flat[ei] = not flat[ei]
+        desc = f"{names[li]}[{ei}] bool inverted (seed {seed})"
+    else:
+        view = flat[ei:ei + 1].view(np.uint8)
+        bit = int(rng.integers(view.size * 8))
+        view[bit // 8] ^= np.uint8(1 << (bit % 8))
+        desc = f"{names[li]}[{ei}] bit {bit} flipped (seed {seed})"
+    leaves = list(leaves)
+    leaves[li] = arr
+    return jax.tree.unflatten(treedef, leaves), desc
+
+
+class FlipInjector:
+    """The engine-level corruption hook ``run_verified(inject=...)``
+    takes (runner.py): fires its flip ONCE, at its chunk boundary,
+    and records what it did (``fired`` / ``desc``) so tests and the
+    in-bench detection gate can assert the flip actually happened."""
+
+    def __init__(self, spec) -> None:
+        self.spec = parse_flip(spec) if isinstance(spec, str) else spec
+        self.fired = False
+        self.desc: Optional[str] = None
+
+    def __call__(self, chunk_idx: int, state):
+        if self.fired or chunk_idx != self.spec.chunk - 1:
+            return None
+        self.fired = True
+        new, self.desc = apply_flip(state, self.spec.seed,
+                                    self.spec.plane)
+        return new

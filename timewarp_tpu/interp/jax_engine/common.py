@@ -114,7 +114,12 @@ class StepOut(NamedTuple):
     (obs/telemetry.py ``TelemetryRow``) — ``None`` unless the engine
     was built with ``telemetry != "off"``. None is an empty pytree
     node, so the default adds zero scan outputs and zero jaxpr
-    equations: the zero-overhead-when-off law holds at the type level."""
+    equations: the zero-overhead-when-off law holds at the type level.
+
+    ``integ`` is the state-integrity guard plane (integrity/checks.py
+    ``IntegrityRow``) — ``None`` unless ``verify != "off"``; the same
+    None-default contract, so the verify-off jaxpr is byte-identical
+    to the pre-knob engine (tests/test_zzzzintegrity.py)."""
     valid: jax.Array
     t: jax.Array
     fired_count: jax.Array
@@ -125,6 +130,7 @@ class StepOut(NamedTuple):
     sent_hash: jax.Array
     overflow: jax.Array
     telem: Any = None
+    integ: Any = None
 
 
 class LocalComm:

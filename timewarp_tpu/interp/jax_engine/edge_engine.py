@@ -62,6 +62,7 @@ from .common import LocalComm, RunStatsMixin, StepOut as _StepOut
 from .common import padded_scan, scan_pad
 from .controlled import ControlledRunMixin
 from .common import thi as _thi, tlo as _tlo, u32sum as _u32sum
+from ...integrity.runner import VerifiedRunMixin
 
 __all__ = ["EdgeEngine", "EdgeState", "EdgeTopology"]
 
@@ -190,7 +191,7 @@ class EdgeState(NamedTuple):
     restart_done: jax.Array
 
 
-class EdgeEngine(RunStatsMixin, ControlledRunMixin):
+class EdgeEngine(RunStatsMixin, ControlledRunMixin, VerifiedRunMixin):
     """Batched engine for static-topology scenarios. Same driver API as
     :class:`~timewarp_tpu.interp.jax_engine.engine.JaxEngine`: ``run``
     (traced, per-superstep rows) and ``run_quiet`` (while_loop, no
@@ -202,11 +203,15 @@ class EdgeEngine(RunStatsMixin, ControlledRunMixin):
     def __init__(self, scenario: Scenario, link: LinkModel, *,
                  seed: int = 0, cap: int = 2,
                  lint: str = "warn", faults=None,
-                 telemetry: str = "off", controller=None) -> None:
+                 telemetry: str = "off", controller=None,
+                 verify: str = "off") -> None:
         # static scenario sanitizer — same knob contract as JaxEngine
         from ...analysis import check_scenario
         from ...obs.telemetry import validate_mode
         self.telemetry = validate_mode(telemetry, type(self).__name__)
+        # state-integrity checking — same knob contract as JaxEngine
+        # (integrity/, docs/integrity.md)
+        self._bind_verify(verify)
         self.metrics = None
         self.metrics_label = type(self).__name__
         self.last_run_telemetry = None
@@ -550,6 +555,18 @@ class EdgeEngine(RunStatsMixin, ControlledRunMixin):
         if self.telemetry != "off":
             telem = self._telemetry_row(wake, q_rel, t, out_valid,
                                         fault_step)
+        integ = None
+        if self.verify != "off":
+            # the guard invariant plane — the JaxEngine twin
+            # (integrity/checks.py; one shared implementation)
+            from ...integrity.checks import make_guard_row
+            integ = make_guard_row(
+                comm, t, st.time,
+                (new_st.overflow, new_st.unrouted, new_st.misrouted,
+                 new_st.bad_delay, new_st.fault_dropped,
+                 new_st.delivered, new_st.steps, new_st.time),
+                wake, jnp.int64(NEVER), (q_rel,),
+                st.restart_done, restart_done, self._faulted)
         yrow = _StepOut(
             valid=live, t=t,
             fired_count=comm.all_sum(jnp.sum(fire, dtype=jnp.int32)),
@@ -559,6 +576,7 @@ class EdgeEngine(RunStatsMixin, ControlledRunMixin):
             sent_hash=comm.all_sum(sent_hash),
             overflow=overflow_step,
             telem=telem,
+            integ=integ,
         )
         yrow = jax.tree.map(
             lambda x: jnp.where(live, x, jnp.zeros_like(x)), yrow)
@@ -662,10 +680,14 @@ class EdgeEngine(RunStatsMixin, ControlledRunMixin):
             ) -> Tuple[EdgeState, SuperstepTrace]:
         st = state if state is not None else self.init_state()
         begin = self._stats_begin()
-        final, ys = self._run_scan(st, scan_pad(max_steps),
+        # _pad_mult = 2 is the shadow verify mode's pow2-cache twin
+        # (integrity/runner.py) — a distinct executable, same results
+        final, ys = self._run_scan(st,
+                                   scan_pad(max_steps) * self._pad_mult,
                                    jnp.asarray(max_steps, jnp.int64))
         ys = jax.device_get(ys)
         self._stats_end(begin, st.steps, final.steps)
+        self._capture_integrity(ys)
         self.last_run_telemetry = None
         if self.telemetry != "off" and ys.telem is not None:
             from ...obs.telemetry import decode_frames
@@ -699,4 +721,8 @@ class EdgeEngine(RunStatsMixin, ControlledRunMixin):
         begin = self._stats_begin()
         final = self._run_while(st, max_steps)
         self._stats_end(begin, st.steps, final.steps)
+        if self.verify != "off":
+            # never silently unverified (JaxEngine.run_quiet twin)
+            from ...integrity.checks import final_state_guard
+            final_state_guard(final, type(self).__name__)
         return final

@@ -54,6 +54,7 @@ from .common import group_rank
 from .common import padded_scan, scan_pad as _scan_pad
 from .common import thi as _thi, tlo as _tlo, u32sum as _u32sum
 from .controlled import ControlledRunMixin
+from ...integrity.runner import VerifiedRunMixin
 
 __all__ = ["JaxEngine", "EngineState", "BatchSpec"]
 
@@ -114,7 +115,7 @@ class EngineState(NamedTuple):
     restart_done: jax.Array
 
 
-class JaxEngine(RunStatsMixin, ControlledRunMixin):
+class JaxEngine(RunStatsMixin, ControlledRunMixin, VerifiedRunMixin):
     """Single-chip batched engine for arbitrary (dynamic-destination)
     scenarios. ``run(max_steps)`` executes up to ``max_steps``
     supersteps under one ``lax.scan`` and returns the final
@@ -257,7 +258,8 @@ class JaxEngine(RunStatsMixin, ControlledRunMixin):
                  telemetry: str = "off",
                  insert: Optional[str] = None,
                  insert_cap: Optional[int] = None,
-                 controller=None) -> None:
+                 controller=None,
+                 verify: str = "off") -> None:
         # static scenario sanitizer (analysis/): "warn" logs findings,
         # "error" refuses to construct on contract violations, "off"
         # skips entirely (bit-for-bit the pre-lint behavior — the
@@ -270,6 +272,14 @@ class JaxEngine(RunStatsMixin, ControlledRunMixin):
         # checkpoints are bit-identical in every mode
         from ...obs.telemetry import validate_mode
         self.telemetry = validate_mode(telemetry, type(self).__name__)
+        # online state-integrity checking (integrity/,
+        # docs/integrity.md): "off" lowers to the exact verify-free
+        # jaxpr (the guard plane is a None StepOut field, like
+        # telemetry); "guard" threads fixed-shape on-device invariant
+        # checks through the traced scan; "digest"/"shadow" add the
+        # per-chunk state digest / pow2-twin re-execution in the
+        # run_verified driver (integrity/runner.py)
+        self._bind_verify(verify)
         #: attachable obs.metrics.MetricsRegistry: when set, every
         #: traced run flushes one aggregated `supersteps` line (per
         #: world, batched) under `metrics_label`
@@ -1555,6 +1565,23 @@ class JaxEngine(RunStatsMixin, ControlledRunMixin):
             telem = self._telemetry_row(wake, mb_rel, t,
                                         route_drop_step,
                                         fault_dropped_step)
+        integ = None
+        if self.verify != "off":
+            # the guard invariant plane (integrity/checks.py):
+            # violation counts over values this superstep already
+            # computed — all-zero on any legitimate superstep, so the
+            # checks cannot perturb the emulation (decoded host-side
+            # by _capture_integrity; mode "off" carries None, keeping
+            # the jaxpr byte-identical to the pre-knob engine)
+            from ...integrity.checks import make_guard_row
+            integ = make_guard_row(
+                comm, t, st.time,
+                (new_st.overflow, new_st.bad_dst, new_st.bad_delay,
+                 new_st.short_delay, new_st.route_drop,
+                 new_st.fault_dropped, new_st.delivered, new_st.steps,
+                 new_st.time, new_st.ev_count),
+                wake, jnp.int64(NEVER), (mb_rel,),
+                st.restart_done, new_st.restart_done, self._faulted)
         yrow = _StepOut(
             valid=live, t=t,
             fired_count=comm.all_sum(jnp.sum(fire, dtype=jnp.int32)),
@@ -1563,6 +1590,7 @@ class JaxEngine(RunStatsMixin, ControlledRunMixin):
             sent_count=sent_count, sent_hash=sent_hash,
             overflow=overflow_step,
             telem=telem,
+            integ=integ,
         )
         # mask the trace row too when not live
         yrow = jax.tree.map(
@@ -1756,10 +1784,15 @@ class JaxEngine(RunStatsMixin, ControlledRunMixin):
         st = state if state is not None else self.init_state()
         budget, top = self._coerce_budget(max_steps)
         begin = self._stats_begin()
-        final, ys = self._run_scan(st, _scan_pad(top), budget, _dyn)
+        # _pad_mult = 2 is the shadow verify mode's pow2-cache twin
+        # (integrity/runner.py): still a pow2 (the masked tail keeps
+        # results bit-identical), but a DIFFERENT compiled executable
+        final, ys = self._run_scan(
+            st, _scan_pad(top) * self._pad_mult, budget, _dyn)
         ys = jax.device_get(ys)
         self._stats_end(begin, st.steps, final.steps)
         self._capture_telemetry(ys)
+        self._capture_integrity(ys)
         if self.batch is not None:
             return final, self._decode_traces(ys)
         m = np.asarray(ys.valid)
@@ -1801,6 +1834,13 @@ class JaxEngine(RunStatsMixin, ControlledRunMixin):
         begin = self._stats_begin()
         final = self._run_while(st, budget)
         self._stats_end(begin, st.steps, final.steps)
+        if self.verify != "off":
+            # never silently unverified: the quiet driver has no
+            # per-superstep rows, so the guard degrades to a final-
+            # state host check (integrity/checks.py) — per-superstep
+            # localization needs run()/run_verified
+            from ...integrity.checks import final_state_guard
+            final_state_guard(final, type(self).__name__)
         return final
 
     def _capture_telemetry(self, ys) -> None:

@@ -295,9 +295,11 @@ class FusedRingEngine(RunStatsMixin):
     to this engine by the fused-ring law)."""
 
     def __init__(self, scenario: Scenario, link, *, cap: int = 2,
-                 lint: str = "warn", telemetry: str = "off") -> None:
+                 lint: str = "warn", telemetry: str = "off",
+                 verify: str = "off") -> None:
         # static scenario sanitizer — same knob contract as EdgeEngine
         from ...analysis import check_scenario
+        from ...integrity.checks import validate_verify
         from ...obs.telemetry import validate_mode
         self.telemetry = validate_mode(telemetry, type(self).__name__)
         if self.telemetry != "off":
@@ -307,6 +309,15 @@ class FusedRingEngine(RunStatsMixin):
                 "per-superstep telemetry planes through; run the XLA "
                 "EdgeEngine (bit-exact to this engine) with "
                 f"telemetry={self.telemetry!r} instead")
+        if validate_verify(verify, type(self).__name__) != "off":
+            # same refusal shape as telemetry: no scan driver to
+            # thread the guard plane (or chunk) through — never a
+            # silently-unverified run
+            raise ValueError(
+                "FusedRingEngine has no chunked scan driver to "
+                "verify; run the XLA EdgeEngine (bit-exact to this "
+                f"engine) with verify={verify!r} instead "
+                "(docs/integrity.md)")
         self.last_run_telemetry = None
         self.lint = lint
         self.lint_report = check_scenario(scenario, lint,

@@ -201,11 +201,11 @@ class FusedSparseEngine(JaxEngine):
                  seed: int = 0, window=1, record_events: int = 0,
                  max_batch: int = 1 << 16,
                  lint: str = "warn", telemetry: str = "off",
-                 controller=None) -> None:
+                 controller=None, verify: str = "off") -> None:
         super().__init__(scenario, link, seed=seed, window=window,
                          route_cap=None, record_events=record_events,
                          lint=lint, telemetry=telemetry,
-                         controller=controller)
+                         controller=controller, verify=verify)
         # the fused kernel bakes the window into its uint32 deliver
         # arithmetic and in-kernel short-delay counter, so a dispatch
         # controller adapts CHUNK LENGTH only here — window/rung ride

@@ -48,9 +48,9 @@ class ShardedEdgeEngine(ShardedDriver, EdgeEngine):
     def __init__(self, scenario: Scenario, link: LinkModel,
                  mesh: Mesh, *, axis: AxisName = "nodes", seed: int = 0,
                  cap: int = 2, lint: str = "warn",
-                 telemetry: str = "off") -> None:
+                 telemetry: str = "off", verify: str = "off") -> None:
         super().__init__(scenario, link, seed=seed, cap=cap, lint=lint,
-                         telemetry=telemetry)
+                         telemetry=telemetry, verify=verify)
         bad = [e for e, s in enumerate(self.topo.shift) if s is None]
         if bad:
             raise ValueError(
@@ -103,10 +103,11 @@ class ShardedEngine(ShardedDriver, JaxEngine):
                  bucket_cap: Optional[int] = None,
                  window: int = 1,
                  route_cap: Optional[int] = None,
-                 lint: str = "warn", telemetry: str = "off") -> None:
+                 lint: str = "warn", telemetry: str = "off",
+                 verify: str = "off") -> None:
         super().__init__(scenario, link, seed=seed, window=window,
                          route_cap=route_cap, lint=lint,
-                         telemetry=telemetry)
+                         telemetry=telemetry, verify=verify)
         self.mesh = mesh
         self.axis = axis
         D = axis_size(mesh, axis)
@@ -204,11 +205,12 @@ class ShardedBatchedEngine(ShardedDriver, JaxEngine):
                  axis: AxisName = "worlds", seed: int = 0,
                  window=1, route_cap: Optional[int] = None,
                  lint: str = "warn", faults=None,
-                 telemetry: str = "off", controller=None) -> None:
+                 telemetry: str = "off", controller=None,
+                 verify: str = "off") -> None:
         super().__init__(scenario, link, seed=seed, window=window,
                          route_cap=route_cap, lint=lint, batch=batch,
                          faults=faults, telemetry=telemetry,
-                         controller=controller)
+                         controller=controller, verify=verify)
         if batch is None:
             raise ValueError(
                 "ShardedBatchedEngine shards the world axis; it needs "
@@ -279,10 +281,11 @@ class ShardedFusedSparseEngine(ShardedEngine):
                  mesh: Mesh, *, axis: AxisName = "nodes", seed: int = 0,
                  bucket_cap: Optional[int] = None,
                  window: int = 1, lint: str = "warn",
-                 telemetry: str = "off") -> None:
+                 telemetry: str = "off", verify: str = "off") -> None:
         super().__init__(scenario, link, mesh, axis=axis, seed=seed,
                          bucket_cap=bucket_cap, window=window,
-                         route_cap=None, lint=lint, telemetry=telemetry)
+                         route_cap=None, lint=lint, telemetry=telemetry,
+                         verify=verify)
         # the kernel machinery's home since round 12 (pallas_insert.py;
         # fused_sparse re-exports for older callers)
         from .pallas_insert import _build_kernel, _insertion_plan

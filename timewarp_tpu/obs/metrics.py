@@ -20,7 +20,11 @@ Kinds:
 - ``decision`` — one online-dispatch controller decision per chunk
   (dispatch/, docs/dispatch.md): window width, rung pin, chunk
   length.
-- ``event`` — a point event (OOM split, terminal failure, …).
+- ``integrity`` — one state-integrity verification event per checked
+  chunk (integrity/, docs/integrity.md): the verify mode, the chunk,
+  and whether the chunk verified or rolled back.
+- ``event`` — a point event (OOM split, terminal failure,
+  integrity violation, …).
 
 The registry validates every line at emit time AND the file is
 re-validatable after the fact — ``python -m timewarp_tpu.obs.metrics
@@ -45,8 +49,9 @@ __all__ = ["METRICS_SCHEMA", "MetricsRegistry", "validate_line",
 
 #: bump when a kind's required fields change shape (or the kind
 #: inventory grows: v2 added the dispatch-controller `decision`
-#: kind — a v1 reader would mis-skip lines it cannot interpret)
-METRICS_SCHEMA = 2
+#: kind, v3 the state-integrity `integrity` kind — a v1 reader would
+#: mis-skip lines it cannot interpret)
+METRICS_SCHEMA = 3
 
 _NUM = (int, float)
 #: kind -> {required field: type tuple}; extra fields are allowed
@@ -67,6 +72,12 @@ _KINDS: Dict[str, Dict[str, tuple]] = {
     # record the decision trace and the sweep journal carry
     "decision": {"chunk": (int,), "window_us": (int,),
                  "rung_pin": (int,), "chunk_len": (int,)},
+    # one state-integrity verification event per checked chunk
+    # (integrity/runner.py, docs/integrity.md): event is "verified"
+    # (the chunk passed every check) or "rollback" (a violation was
+    # detected and the run restored its last verified snapshot)
+    "integrity": {"label": (str,), "mode": (str,), "chunk": (int,),
+                  "event": (str,)},
     "event": {"name": (str,)},
 }
 
@@ -79,8 +90,9 @@ def validate_line(rec: Any) -> None:
                          f"{type(rec).__name__}")
     sv = rec.get("schema")
     # accept every schema this reader understands: bumps so far are
-    # purely additive (v2 added the `decision` kind), so a v1 archive
-    # must keep validating — only a FUTURE schema is unreadable
+    # purely additive (v2 added the `decision` kind, v3 `integrity`),
+    # so a v1 archive must keep validating — only a FUTURE schema is
+    # unreadable
     if isinstance(sv, bool) or not isinstance(sv, int) \
             or not 1 <= sv <= METRICS_SCHEMA:
         raise ValueError(

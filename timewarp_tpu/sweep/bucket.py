@@ -116,7 +116,8 @@ def plan_buckets(configs, max_bucket: int = 64) -> List[Bucket]:
 
 
 def build_bucket_engine(bucket: Bucket, *, lint: str = "warn",
-                        telemetry: str = "off", controller=None):
+                        telemetry: str = "off", controller=None,
+                        verify: str = "off"):
     """One batched :class:`~timewarp_tpu.interp.jax_engine.engine.
     JaxEngine` serving every world of the bucket. World b's seed,
     sweepable link values, and (padded) fault schedule are exactly
@@ -155,8 +156,11 @@ def build_bucket_engine(bucket: Bucket, *, lint: str = "warn",
         # decide; force the cheap counters mode (bit-exact by the
         # telemetry law, so streamed results are unchanged)
         telemetry = "counters"
+    # verify is bit-exact like telemetry (the guard plane feeds
+    # nothing back), so streamed results stay mode-independent and
+    # the sweep survival law's solo twin needs no knob of its own
     eng = JaxEngine(sc, links[0], window=bucket.window, batch=spec,
                     faults=fleet, lint=lint, telemetry=telemetry,
-                    controller=controller)
+                    controller=controller, verify=verify)
     eng.metrics_label = f"bucket:{bucket.bucket_id}"
     return eng

@@ -64,9 +64,23 @@ def _service_args(p: argparse.ArgumentParser) -> None:
                    choices=["error", "warn", "off"])
     p.add_argument("--inject", default=None,
                    help="deterministic failure injection: fail:K | "
-                        "oom:K | die:K | hang:K:MS (';'-joined, K = "
+                        "oom:K | die:K | hang:K:MS | "
+                        "flip:SEED[:K[:PLANE]] (';'-joined, K = "
                         "1-based chunk call) — CI/test chaos for the "
-                        "sweep machinery itself")
+                        "sweep machinery itself; flip writes a seeded "
+                        "bit-flip into a bucket's state between "
+                        "chunks (docs/integrity.md)")
+    p.add_argument("--state-verify", default="off",
+                   choices=["off", "guard", "digest"],
+                   help="online state-integrity checking per bucket "
+                        "(integrity/, docs/integrity.md): guard = "
+                        "on-device invariant checks in every chunk; "
+                        "digest = + rolling per-world state digest "
+                        "verified at each chunk entry and chained "
+                        "through the checkpoints (verified epochs). "
+                        "Detection journals integrity_violation and "
+                        "rolls the bucket back to its last verified "
+                        "checkpoint")
     p.add_argument("--verify", action="store_true",
                    help="after the sweep, re-run every completed "
                         "world solo and assert the streamed result is "
@@ -93,7 +107,8 @@ def _kw(args) -> dict:
                 bucket_timeout_us=args.timeout_us,
                 grace_us=args.grace_us, max_bucket=args.max_bucket,
                 lint=args.lint, inject=args.inject,
-                telemetry=args.telemetry, trace_out=args.trace_out)
+                telemetry=args.telemetry, trace_out=args.trace_out,
+                verify=args.state_verify)
 
 
 def _finish(svc: SweepService, verify: bool) -> int:
@@ -180,6 +195,9 @@ def _status(argv) -> int:
         # the batched executables were used — worlds-active occupancy,
         # budget-mask efficiency, pow2 scan-pad waste
         "utilization": scan.util,
+        # detected-and-rolled-back state corruptions (integrity/):
+        # a nonzero count on real hardware means an SDC-prone host
+        "integrity_violations": scan.integrity,
         "pack_sha": scan.pack_sha}))
     return 0
 

@@ -63,6 +63,10 @@ class JournalState:
     #: run_id -> bucket_id that streamed its result (what --verify
     #: uses to assemble a controller world's decision chain)
     world_bucket: Dict[str, str] = field(default_factory=dict)
+    #: integrity_violation events (integrity/, docs/integrity.md):
+    #: each one a detected state corruption that was rolled back —
+    #: surfaced in `sweep status` so an SDC-prone host is visible
+    integrity: List[dict] = field(default_factory=list)
 
     def decision_chain(self, bucket_id: str) -> List[dict]:
         """Every decision record governing ``bucket_id``'s worlds, in
@@ -211,6 +215,9 @@ class SweepJournal:
                     k: v for k, v in rec.items() if k != "ev"}
             elif ev == "retry":
                 st.retries += 1
+            elif ev == "integrity_violation":
+                st.integrity.append(
+                    {k: v for k, v in rec.items() if k != "ev"})
             elif ev == "dispatch_decision":
                 dl = st.decisions.setdefault(rec["bucket"], [])
                 d = rec["decision"]

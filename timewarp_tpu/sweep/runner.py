@@ -58,7 +58,7 @@ class BucketRunner:
                  done: Dict[str, dict], *, lint: str = "warn",
                  chunk: int = 64, inject=None,
                  telemetry: str = "off", metrics=None,
-                 prior_decisions=()) -> None:
+                 prior_decisions=(), verify: str = "off") -> None:
         self.bucket = bucket
         self.journal = journal
         #: shared run_id -> result map (journaled results land here
@@ -82,6 +82,19 @@ class BucketRunner:
         #: (the engine chunk-flushes `supersteps` lines into it)
         self.telemetry = telemetry
         self.metrics = metrics
+        #: online state-integrity mode (integrity/, docs/integrity.md):
+        #: "guard" builds the bucket engine with the on-device
+        #: invariant plane; "digest" additionally keeps a per-world
+        #: rolling state digest, verified at every chunk ENTRY and
+        #: chained into the checkpoint meta — each checkpoint is a
+        #: verified epoch, and detection raises IntegrityViolation
+        #: (the service journals it and retries from that checkpoint:
+        #: deterministic rollback of just this bucket)
+        self.verify = verify
+        #: per-world uint32 state digests at the last verified epoch
+        self.vdigests = None
+        #: per-world sha256 digest chain over the verified epochs
+        self.vchain: Optional[List[str]] = None
         self.attempts = 0
         #: attempt generation (module docstring): bumped by
         #: begin_attempt and by abandon, so a zombie thread's stamped
@@ -149,9 +162,13 @@ class BucketRunner:
                     chunk_min=min(8, self.chunk),
                     chunk_max=self.chunk,
                     replay=self.prior_decisions)
-            engine = build_bucket_engine(self.bucket, lint=self.lint,
-                                         telemetry=self.telemetry,
-                                         controller=ctrl)
+            engine = build_bucket_engine(
+                self.bucket, lint=self.lint, telemetry=self.telemetry,
+                controller=ctrl,
+                # digest mode includes the guard rung of the ladder
+                # (the in-scan invariants); the digest itself is this
+                # runner's chunk-boundary business
+                verify="off" if self.verify == "off" else "guard")
             engine.metrics = self.metrics
         path = self.journal.checkpoint_path(self.bucket.bucket_id)
         B = self.bucket.B
@@ -166,9 +183,38 @@ class BucketRunner:
             chunks = int(meta.get("chunks", 0))
         else:
             st = engine.init_state()
+            meta = None
             digests = [DIGEST_ZERO] * B
             supersteps = [0] * B
             chunks = 0
+        vdigests = vchain = None
+        if self.verify == "digest":
+            # a restored checkpoint must match the digests its meta
+            # recorded (the verified-epoch contract): the per-leaf
+            # sha in utils/checkpoint.py caught at-rest disk
+            # corruption; this catches a chain that was broken before
+            # the checkpoint was even written (and seeds the chain
+            # the coming chunks extend). The recompute runs every
+            # retry, so resuming onto corrupt state is impossible.
+            from ..integrity.checks import IntegrityViolation
+            from ..integrity.digest import (VERIFY_CHAIN_ZERO,
+                                            first_digest_mismatch,
+                                            host_digests)
+            vdigests = host_digests(st, engine.batch)
+            if meta is not None and "state_digests" in meta:
+                hit = first_digest_mismatch(vdigests,
+                                            meta["state_digests"])
+                if hit is not None:
+                    bad, got_h, want_h = hit
+                    raise IntegrityViolation(
+                        f"bucket {self.bucket.bucket_id!r} checkpoint "
+                        f"{path!r} world {bad}: restored state digest "
+                        f"{got_h} != recorded {want_h} "
+                        "— the checkpoint is not the verified epoch "
+                        "its meta claims (docs/integrity.md)")
+                vchain = list(meta["verify_chain"])
+            else:
+                vchain = [VERIFY_CHAIN_ZERO] * B
         with self._lock:
             self._check(epoch)
             if self.engine is None:
@@ -180,6 +226,8 @@ class BucketRunner:
             self.digests = digests
             self.supersteps = supersteps
             self.chunks = chunks
+            self.vdigests = vdigests
+            self.vchain = vchain
             self.emitted = set(self.done)
             # a retry restarts from the checkpoint: the telemetry the
             # in-flight chunk produced is gone, which is exactly why
@@ -203,7 +251,40 @@ class BucketRunner:
         self._check(epoch)
         if self.inject is not None:
             self.inject()
+            # the flip: form corrupts the in-memory state between
+            # chunks (integrity/inject.py) — exactly the window the
+            # entry digest check below covers
+            hook = getattr(self.inject, "flip_hook", None)
+            if hook is not None:
+                hook(self)
         eng = self.engine
+        if self.verify == "digest" and self.vdigests is not None:
+            # chunk-entry verification: the state arrays did not
+            # legitimately change since the last verified epoch, so
+            # any digest movement is corruption at rest — detected
+            # BEFORE the corrupt state runs a superstep. The raise
+            # unwinds to the service, which journals the
+            # integrity_violation and retries from the last verified
+            # checkpoint (deterministic rollback of this bucket only)
+            from ..integrity.checks import IntegrityViolation
+            from ..integrity.digest import (first_digest_mismatch,
+                                            host_digests)
+            ver_cm = (self.metrics.span(
+                "verify", bucket=self.bucket.bucket_id)
+                if self.metrics is not None
+                else contextlib.nullcontext())
+            with ver_cm:
+                hit = first_digest_mismatch(
+                    host_digests(self.state, eng.batch),
+                    self.vdigests)
+            if hit is not None:
+                bad, got_h, want_h = hit
+                raise IntegrityViolation(
+                    f"bucket {self.bucket.bucket_id!r} chunk "
+                    f"{self.chunks} world {bad}: state digest "
+                    f"{got_h} != last verified {want_h} — state "
+                    "corrupted between chunks; rolling back to the "
+                    "last verified checkpoint (docs/integrity.md)")
         # snapshot the attempt's view; commits re-check the epoch
         st, digests = self.state, list(self.digests)
         supersteps = list(self.supersteps)
@@ -278,6 +359,16 @@ class BucketRunner:
         for b in range(B):
             digests[b] = chain_digest(digests[b], traces[b])
             supersteps[b] += len(traces[b])
+        vdig2 = vchain2 = None
+        if self.verify == "digest":
+            # the new verified epoch: digest the post-chunk state and
+            # extend the per-world sha256 chain — recorded in the
+            # checkpoint meta below, so the checkpoint IS the epoch
+            from ..integrity.digest import (chain_state_digest,
+                                            host_digests)
+            vdig2 = host_digests(new_state, eng.batch)
+            vchain2 = [chain_state_digest(self.vchain[b], vdig2[b])
+                       for b in range(B)]
         top = int(vec.max())
         with self._lock:
             self._check(epoch)
@@ -286,6 +377,9 @@ class BucketRunner:
             self.supersteps = supersteps
             self.chunks = ci + 1
             self.wall_s += chunk_wall
+            if vdig2 is not None:
+                self.vdigests = vdig2
+                self.vchain = vchain2
             # utilization bookkeeping: the fleet executed B ×
             # scan_pad(top) superstep bodies for Σ len(traces[b]) real
             # (unmasked) ones — the gap is pad waste + budget masking
@@ -301,15 +395,22 @@ class BucketRunner:
                 "checkpoint", bucket=self.bucket.bucket_id)
                 if self.metrics is not None
                 else contextlib.nullcontext())
+            meta = {"bucket": self.bucket.bucket_id,
+                    "run_ids": list(self.bucket.run_ids),
+                    "digests": list(digests),
+                    "supersteps": [int(s) for s in supersteps],
+                    "chunks": ci + 1}
+            if vdig2 is not None:
+                # the verified-epoch extension of the existing sha256
+                # digest chain (docs/integrity.md): resume recomputes
+                # state_digests from the restored arrays and refuses
+                # a checkpoint that no longer matches its own record
+                meta["state_digests"] = [int(d) for d in vdig2]
+                meta["verify_chain"] = list(vchain2)
             with ckpt_cm:
                 save_state(
                     self.journal.checkpoint_path(self.bucket.bucket_id),
-                    new_state,
-                    meta={"bucket": self.bucket.bucket_id,
-                          "run_ids": list(self.bucket.run_ids),
-                          "digests": list(digests),
-                          "supersteps": [int(s) for s in supersteps],
-                          "chunks": ci + 1})
+                    new_state, meta=meta)
         return "running"
 
     def utilization(self) -> dict:
@@ -387,19 +488,29 @@ class BucketRunner:
                              inject=self.inject,
                              telemetry=self.telemetry,
                              metrics=self.metrics,
-                             prior_decisions=kid_decisions)
+                             prior_decisions=kid_decisions,
+                             verify=self.verify)
             if self.state is not None:
                 idx = np.asarray(idxs)
                 child_state = jax.tree.map(lambda x: x[idx], self.state)
                 from ..utils.checkpoint import save_state
+                meta = {"bucket": child.bucket_id,
+                        "run_ids": list(child.run_ids),
+                        "digests": [self.digests[i] for i in idxs],
+                        "supersteps": [self.supersteps[i]
+                                       for i in idxs],
+                        "chunks": self.chunks}
+                if self.vdigests is not None:
+                    # world slices are exact (batch exactness law), so
+                    # the per-world verified-epoch chain slices with
+                    # them — the child checkpoint stays a verified
+                    # epoch
+                    meta["state_digests"] = [int(self.vdigests[i])
+                                             for i in idxs]
+                    meta["verify_chain"] = [self.vchain[i]
+                                            for i in idxs]
                 save_state(
                     self.journal.checkpoint_path(child.bucket_id),
-                    child_state,
-                    meta={"bucket": child.bucket_id,
-                          "run_ids": list(child.run_ids),
-                          "digests": [self.digests[i] for i in idxs],
-                          "supersteps": [self.supersteps[i]
-                                         for i in idxs],
-                          "chunks": self.chunks})
+                    child_state, meta=meta)
             runners.append(r)
         return runners

@@ -92,16 +92,24 @@ def _is_oom(e: BaseException) -> bool:
 class InjectPlan:
     """Deterministic chaos for the service itself (the emulator's
     chaos is faults/; this injects failures into the *sweep
-    machinery*). Grammar: ``fail:K | oom:K | die:K | hang:K:MS``,
-    ';'-joined — trigger at the K-th chunk-executor call (1-based,
-    counted across the whole sweep), once each."""
+    machinery*). Grammar: ``fail:K | oom:K | die:K | hang:K:MS |
+    flip:SEED[:K[:PLANE]]``, ';'-joined — trigger at the K-th
+    chunk-executor call (1-based, counted across the whole sweep),
+    once each. ``flip:`` (integrity/inject.py, round 14) is the
+    state-corruption form the detection law is tested against: a
+    seeded bit-flip written into the bucket's in-memory state between
+    chunks — what the ``verify`` knob must catch and roll back."""
 
-    GRAMMAR = ("fail:K | oom:K | die:K | hang:K:MS  "
-               "(';'-joined; K = 1-based chunk call, fires once)")
+    GRAMMAR = ("fail:K | oom:K | die:K | hang:K:MS | "
+               "flip:SEED[:K[:PLANE]]  "
+               "(';'-joined; K = 1-based chunk call, fires once; "
+               "flip = seeded bit-flip into a state plane — "
+               "docs/integrity.md)")
 
     def __init__(self, spec: str) -> None:
         self.fail, self.oom, self.die = set(), set(), set()
         self.hang: Dict[int, int] = {}
+        self.flip: Dict[int, object] = {}
         self.calls = 0
         self.fired: List[str] = []
         for part in spec.split(";"):
@@ -110,6 +118,20 @@ class InjectPlan:
                 continue
             bits = part.split(":")
             try:
+                if bits[0] == "flip":
+                    # full grammar (incl. INJECT_GRAMMAR naming on
+                    # malformation) lives in integrity/inject.py
+                    from ..integrity.inject import parse_flip
+                    fs = parse_flip(part)
+                    if fs.chunk in self.flip:
+                        # two flips on one chunk call would silently
+                        # overwrite each other — refuse like any
+                        # other malformation
+                        raise ValueError(
+                            f"duplicate flip at chunk call "
+                            f"{fs.chunk}")
+                    self.flip[fs.chunk] = fs
+                    continue
                 kind, k = bits[0], int(bits[1])
                 if kind == "fail" and len(bits) == 2:
                     self.fail.add(k)
@@ -121,14 +143,17 @@ class InjectPlan:
                     self.hang[k] = int(bits[2])
                 else:
                     raise ValueError(part)
-            except (IndexError, ValueError):
+            except (IndexError, ValueError) as e:
                 # a library-raised, catchable error (the CLI converts
                 # it to a grammar-named exit; an embedding caller —
-                # bench, notebook — must not have its process killed)
+                # bench, notebook — must not have its process killed).
+                # A flip malformation's own message (naming the
+                # INJECT_GRAMMAR flip form) rides along verbatim.
                 from .spec import SweepConfigError
+                detail = f": {e}" if bits and bits[0] == "flip" else ""
                 raise SweepConfigError(
                     f"malformed inject spec {part!r}; grammar: "
-                    f"{self.GRAMMAR}") from None
+                    f"{self.GRAMMAR}{detail}") from None
 
     def __call__(self) -> None:
         self.calls += 1
@@ -149,6 +174,24 @@ class InjectPlan:
         if n in self.die:
             self.fired.append(f"die:{n}")
             raise SweepKilled(f"injected sweep kill at chunk call {n}")
+
+    def flip_hook(self, runner) -> None:
+        """Corrupt the runner's in-memory state if a ``flip:`` spec
+        is due at the current chunk call (the runner calls this right
+        after ``__call__`` counted the call). Fires once — rollback
+        re-runs the same chunk, and re-corrupting the recovered state
+        would make recovery unfalsifiable."""
+        n = self.calls
+        fs = self.flip.get(n)
+        tag = f"flip:{n}"
+        if fs is None or tag in self.fired or runner.state is None:
+            return
+        from ..integrity.inject import apply_flip
+        self.fired.append(tag)
+        runner.state, desc = apply_flip(runner.state, fs.seed,
+                                        fs.plane)
+        _log.warning("sweep: injected state corruption at chunk call "
+                     "%d — %s", n, desc)
 
 
 @dataclass
@@ -188,11 +231,33 @@ class SweepService:
                  grace_us: int = 500_000, max_bucket: int = 64,
                  lint: str = "warn", inject=None,
                  telemetry: str = "off",
-                 trace_out: Optional[str] = None) -> None:
+                 trace_out: Optional[str] = None,
+                 verify: str = "off") -> None:
         if chunk < 1:
             raise ValueError(f"chunk must be >= 1, got {chunk}")
         if max_retries < 0:
             raise ValueError("max_retries must be >= 0")
+        # online state-integrity checking per bucket (integrity/,
+        # docs/integrity.md): "guard" threads the on-device invariant
+        # plane through every bucket engine's scans; "digest" adds
+        # the per-chunk rolling state digest — verified at every
+        # chunk entry and chained through the checkpoints, so each
+        # checkpoint marks a verified epoch. Detection journals an
+        # `integrity_violation` event and ROLLS BACK just the
+        # affected bucket: the existing retry machinery restores the
+        # last verified checkpoint and replays the journaled
+        # dispatch-decision chain — bit-identical recovery by the
+        # replay law. "shadow" (sampled re-execution) is the solo
+        # driver's mode (run_verified); refused here rather than
+        # silently downgraded.
+        from ..integrity.checks import validate_verify
+        self.verify = validate_verify(verify, type(self).__name__)
+        if self.verify == "shadow":
+            raise ValueError(
+                "the sweep service verifies buckets with "
+                "verify='guard'|'digest'; shadow re-execution is the "
+                "solo chunked driver's mode "
+                "(engine.run_verified, docs/integrity.md)")
         self.pack = pack
         self.journal = SweepJournal(journal_dir)
         self.chunk = chunk
@@ -204,6 +269,17 @@ class SweepService:
         self.lint = lint
         self.inject = (InjectPlan(inject) if isinstance(inject, str)
                        else inject)
+        if getattr(self.inject, "flip", None) \
+                and self.verify != "digest":
+            # mirror of the solo CLI's guard: a flip without the
+            # digest entry check would corrupt streamed results
+            # SILENTLY (guard misses most planes by design) — the
+            # detection-law test would test nothing
+            raise ValueError(
+                "--inject flip: corrupts bucket state between "
+                "chunks; it needs --state-verify digest or the "
+                "corruption goes undetected into the journaled "
+                "results (docs/integrity.md)")
         # observability (obs/, docs/observability.md): when telemetry
         # is on, the bucket engines thread counter planes through
         # their scans (bit-exact — the streamed results are
@@ -291,6 +367,7 @@ class SweepService:
                     bucket, self.journal, self.done, lint=self.lint,
                     chunk=self.chunk, inject=self.inject,
                     telemetry=self.telemetry, metrics=self.metrics,
+                    verify=self.verify,
                     # resume replays the journaled dispatch-decision
                     # chain (split-ancestor prefixes included) so a
                     # pre-kill decision is never re-made differently
@@ -437,6 +514,29 @@ class SweepService:
             err = out.error
             if isinstance(err, SweepKilled):
                 raise err  # the injected hard kill: abort the process
+            from ..integrity.checks import IntegrityViolation
+            if isinstance(err, IntegrityViolation):
+                # detected state corruption (or a real bug surfacing
+                # through the exactness laws): journal it — never
+                # silent — then fall through to the retry path, which
+                # IS the deterministic rollback: the attempt restarts
+                # from the bucket's last verified checkpoint and
+                # replays the journaled dispatch-decision chain, so
+                # the recovered bucket is bit-identical to an
+                # uncorrupted run (docs/integrity.md; the detection
+                # law, tests/test_zzzzintegrity.py)
+                self.journal.append({
+                    "ev": "integrity_violation",
+                    "bucket": runner.bucket.bucket_id,
+                    "attempt": runner.attempts,
+                    "detail": str(err)[:500]})
+                if self.metrics is not None:
+                    self.metrics.event("integrity_violation",
+                                       bucket=runner.bucket.bucket_id)
+                _log.warning("sweep: bucket %s INTEGRITY VIOLATION "
+                             "(%s) — rolling back to its last "
+                             "verified checkpoint",
+                             runner.bucket.bucket_id, err)
             if err is not None and _is_oom(err):
                 if runner.bucket.B > 1:
                     if self.metrics is not None:

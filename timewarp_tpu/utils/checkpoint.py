@@ -43,6 +43,7 @@ can refuse a mismatched fleet before the RNG streams diverge.
 
 from __future__ import annotations
 
+import hashlib
 import json
 import os
 import tempfile
@@ -56,8 +57,8 @@ __all__ = ["save_state", "load_state", "atomic_write"]
 
 #: the layout every actionable corrupt-load error names
 _LAYOUT = ("an .npz holding leaf_0..leaf_{n-1} state arrays plus "
-           "__treedef__/__meta__/__n__ headers, written by "
-           "timewarp_tpu.utils.checkpoint.save_state")
+           "__treedef__/__meta__/__n__/__leafsha__ headers, written "
+           "by timewarp_tpu.utils.checkpoint.save_state")
 
 
 def atomic_write(path: str, write_fn, mode: str = "wb") -> None:
@@ -96,6 +97,15 @@ def save_state(path: str, state: Any, *, meta: dict = None) -> None:
     leaves, treedef = jax.tree.flatten(state)
     arrays = {f"leaf_{i}": np.asarray(jax.device_get(x))
               for i, x in enumerate(leaves)}
+    # per-leaf sha256 over the raw array bytes: load_state recomputes
+    # and compares, so a state corrupted ON DISK (bit rot, external
+    # truncation inside the zip's tolerance) fails loudly naming the
+    # leaf instead of restoring garbage (integrity/, ISSUE 10
+    # satellite — before this, the digests rode only in sweep meta
+    # and nothing checked them at load)
+    arrays["__leafsha__"] = np.frombuffer(json.dumps(
+        [hashlib.sha256(arrays[f"leaf_{i}"].tobytes()).hexdigest()
+         for i in range(len(leaves))]).encode(), dtype=np.uint8)
     arrays["__treedef__"] = np.frombuffer(
         str(treedef).encode(), dtype=np.uint8)
     arrays["__meta__"] = np.frombuffer(
@@ -116,6 +126,11 @@ def load_state(path: str, like: Any, *, expect_meta: dict = None):
             meta = json.loads(bytes(z["__meta__"].tobytes()).decode())
             saved_treedef = bytes(z["__treedef__"].tobytes()).decode()
             leaves = [z[f"leaf_{i}"] for i in range(n)]
+            # pre-digest-chain checkpoints lack the header: loadable,
+            # just unverified (there is nothing to verify against)
+            leaf_sha = (json.loads(bytes(
+                z["__leafsha__"].tobytes()).decode())
+                if "__leafsha__" in z.files else None)
     except (FileNotFoundError, PermissionError, IsADirectoryError):
         # access problems are not corruption: relabeling EACCES as
         # "corrupt, delete it" would be destructive advice for an
@@ -134,6 +149,28 @@ def load_state(path: str, like: Any, *, expect_meta: dict = None):
             f"({type(e).__name__}: {e}); expected layout: {_LAYOUT}. "
             f"Delete the file and resume from an earlier checkpoint "
             f"or re-run from the scenario start.") from e
+    if leaf_sha is not None:
+        # verify the recorded digests BEFORE any widening/unflatten:
+        # the shas cover the bytes as written, and a corrupt leaf must
+        # never reach a resumed run (integrity/ detection law's
+        # at-rest half). The error names file, leaf, and both digests
+        # — enough to decide "restore an earlier checkpoint" without
+        # forensic tooling.
+        if len(leaf_sha) != n:
+            raise ValueError(
+                f"checkpoint {path!r} records {len(leaf_sha)} leaf "
+                f"digests for {n} leaves; expected layout: {_LAYOUT}")
+        for i, got in enumerate(leaves):
+            actual = hashlib.sha256(
+                np.ascontiguousarray(got).tobytes()).hexdigest()
+            if actual != leaf_sha[i]:
+                raise ValueError(
+                    f"checkpoint {path!r} leaf {i} failed its "
+                    f"recorded sha256 digest (expected "
+                    f"{leaf_sha[i][:16]}…, actual {actual[:16]}…): "
+                    "the state bytes were corrupted on disk — delete "
+                    "the file and resume from an earlier verified "
+                    "checkpoint (docs/integrity.md)")
     t_leaves, treedef = jax.tree.flatten(like)
     if len(t_leaves) != n:
         raise ValueError(
