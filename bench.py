@@ -1089,6 +1089,106 @@ def bench_gossip_100k_verify(n, steps):
             {"verify_overhead_frac": overheads})
 
 
+def bench_gossip_100k_record(n, steps):
+    """Causal flight recorder (obs/flight.py, docs/observability.md):
+    the gossip wave through the traced chunked driver under every
+    record mode, reporting ``record_overhead_frac`` per mode vs the
+    same driver with record off. Gated in-bench by the record
+    exactness law (off ≡ deliveries ≡ full, bit-for-bit on states
+    AND trace rows, before any measured number counts) and by the
+    deliveries-mode overhead budget: <= 10% at the SMOKE shape and
+    above, CPU included — the slim deliveries row is one cumsum +
+    searchsorted compaction per superstep (obs/flight.py
+    ``record_deliveries``), cheap enough that even noisy CPU smoke
+    windows must clear it. Below the SMOKE shape (the tier-1 tiny
+    run) the measured windows are too short for the ratio to mean
+    anything, so — like ``_telemetry_gate`` and
+    ``gossip_100k_verify`` — the bound loosens to a catastrophic
+    2x regression check and the honest ratio rides the JSON line.
+    Full mode
+    (sends + fault captures across the routing switch) rides the
+    JSON line honestly, ungated. Event/drop counts are reported too:
+    a nonzero ``dropped`` means the wave peak outran ``record_cap``
+    (counted, never silent — obs/flight.py)."""
+    import statistics
+
+    import numpy as np
+
+    from timewarp_tpu.interp.jax_engine.engine import JaxEngine
+    from timewarp_tpu.trace.events import (assert_states_equal,
+                                           assert_traces_equal)
+
+    n = n or 100_000
+    sc, link = _gossip_wave(n)
+    cap = 4096
+
+    def make(mode):
+        return JaxEngine(sc, link, window="auto", lint="off",
+                         record=mode, record_cap=cap)
+
+    # the exactness gate: every mode is the same emulation
+    off = make("off")
+    f_off, tr_off = off.run(24)
+    for mode in ("deliveries", "full"):
+        eng = make(mode)
+        f, tr = eng.run(24)
+        assert_traces_equal(tr_off, tr, "record-off",
+                            f"record-{mode}")
+        assert_states_equal(f_off, f, f"record={mode} exactness gate")
+
+    budget = steps or (1 << 20)
+    chunk = 256
+
+    def drive(eng):
+        # the chunked traced drive a recorded run actually uses (the
+        # whole-budget scan would materialize a [budget, cap] event
+        # plane; chunking bounds it at [chunk, cap], drained per
+        # chunk like run_stream/run_verified do)
+        st = eng.init_state()
+        done = events = dropped = 0
+        while done < budget:
+            step = int(min(chunk, budget - done))
+            st, tr = eng.run(step, state=st)
+            done += len(tr)
+            log = eng.last_run_flight
+            if log is not None:
+                events += len(log)
+                dropped += log.dropped
+            if len(tr) < step:      # quiesced inside the chunk
+                break
+        return st, events, dropped
+
+    def med(mode, reps=3):
+        eng = make(mode)
+        drive(eng)                  # warm the compiles
+        walls, out = [], None
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            out = drive(eng)
+            walls.append(time.perf_counter() - t0)
+        return statistics.median(walls), out, eng
+
+    w_off, (fin, _, _), eng_off = med("off")
+    _assert_wave_done(eng_off, fin, n)
+    delivered = int(np.asarray(jax.device_get(fin.delivered)).sum())
+    overheads, counts = {}, {}
+    for mode in ("deliveries", "full"):
+        w_m, (_f, events, dropped), _e = med(mode)
+        overheads[mode] = round(w_m / w_off - 1.0, 4)
+        counts[mode] = {"events": events, "dropped": dropped}
+    strict = n >= SMOKE["gossip_100k_record"][0]
+    limit = 0.10 if strict else 1.0
+    assert overheads["deliveries"] <= limit, (
+        f"record='deliveries' costs {overheads['deliveries']:.1%} on "
+        f"the traced chunked driver — over the {limit:.0%} budget "
+        "(obs/flight.py overhead contract)")
+    return (f"gossip broadcast wave to quiescence (traced chunked "
+            f"driver, record=off) delivered-messages/sec/chip "
+            f"@{n} nodes", delivered / w_off,
+            {"record_overhead_frac": overheads,
+             "record_events": counts, "record_cap": cap})
+
+
 CONFIGS = {
     "token_ring_dense": bench_token_ring_dense,
     "token_ring_dense_xla": bench_token_ring_dense_xla,
@@ -1100,6 +1200,7 @@ CONFIGS = {
     "gossip_100k_chaos": bench_gossip_100k_chaos,
     "gossip_100k_auto": bench_gossip_100k_auto,
     "gossip_100k_verify": bench_gossip_100k_verify,
+    "gossip_100k_record": bench_gossip_100k_record,
     "gossip_steady_1m": bench_gossip_steady_1m,
     "praos_1m": bench_praos_1m,
     "praos_1m_fused": bench_praos_1m_fused,
@@ -1123,6 +1224,7 @@ SMOKE = {
     "gossip_100k_chaos": (1024, 1 << 14),
     "gossip_100k_auto": (1024, 1 << 14),
     "gossip_100k_verify": (1024, 1 << 14),
+    "gossip_100k_record": (1024, 1 << 14),
     "gossip_steady_1m": (4096, 16),
     "praos_1m": (2048, 24),
     "praos_1m_fused": (2048, 24),

@@ -19,6 +19,7 @@ def test_bench_config_runs(cfg):
          "gossip_100k_insert": 2048,
          "gossip_100k_b8": 512, "gossip_100k_chaos": 512,
          "gossip_100k_auto": 512, "gossip_100k_verify": 512,
+         "gossip_100k_record": 512,
          "gossip_steady_1m": 512,
          "praos_1m": 512, "praos_1m_fused": 2048,
          "praos_1m_insert": 2048,
@@ -36,6 +37,15 @@ def test_bench_config_runs(cfg):
         # the JSON line: every world's schedule must actually bite
         assert all(v > 0 for v in extra["fault_dropped"])
         assert all(v == 0 for v in extra["route_drop"])
+    if cfg == "gossip_100k_record":
+        # the flight-recorder config reports honest per-mode numbers
+        # (obs/flight.py): both modes measured, events recorded, and
+        # drops — if any — counted, never silent
+        assert set(extra["record_overhead_frac"]) \
+            == {"deliveries", "full"}
+        assert extra["record_events"]["deliveries"]["events"] > 0
+        assert extra["record_events"]["full"]["events"] \
+            > extra["record_events"]["deliveries"]["events"]
 
 
 def test_bench_main_prints_one_json_line(capsys, monkeypatch):

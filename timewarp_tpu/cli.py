@@ -17,9 +17,12 @@ utils/checkpoint.py.
 Subcommands: ``timewarp-tpu lint`` (the scenario sanitizer sweep,
 below), ``timewarp-tpu sweep run|resume|status`` (the fault-tolerant
 sweep service over heterogeneous world packs — sweep/cli.py,
-docs/sweeps.md), and ``timewarp-tpu profile FAMILY`` (run a config
-under full telemetry and emit a ready-to-open Perfetto trace —
-docs/observability.md).
+docs/sweeps.md), ``timewarp-tpu profile FAMILY`` (run a config
+under full telemetry and emit a ready-to-open Perfetto trace),
+``timewarp-tpu explain EVENTS.jsonl`` (reconstruct a delivery's
+causal chain from a recorded flight log), and ``timewarp-tpu bisect
+FAMILY`` (binary-search two divergent runs to the first diverging
+chunk/superstep/field — docs/observability.md).
 
 Observability flags on runs (docs/observability.md): ``--telemetry
 off|counters|full`` (bit-exact, zero overhead when off),
@@ -195,11 +198,29 @@ def build_controller(args):
     return ctrl
 
 
+#: engines that carry the causal flight recorder (obs/flight.py) —
+#: the scan-driver engines whose events live on one host (the
+#: node-sharded engines refuse: events would scatter across shards)
+RECORD_ENGINES = ("general", "edge", "fused-sparse",
+                  "sharded-batched")
+
+
 def build_engine(args, sc, link):
     batch = build_batch(args)
     faults = build_faults(args)
     telemetry = getattr(args, "telemetry", "off")
     verify = getattr(args, "verify", "off")
+    record = getattr(args, "record", "off")
+    record_cap = getattr(args, "record_cap", None)
+    if record != "off" and args.engine not in RECORD_ENGINES:
+        raise SystemExit(
+            f"--record threads the flight recorder's event plane "
+            f"through the scan-driver engines "
+            f"({', '.join(RECORD_ENGINES)}); {args.engine} "
+            "cannot carry one (the oracle is host Python — already "
+            "observable; node-sharded engines scatter events across "
+            "shards — record the 1-device twin, bit-identical by "
+            "the sharding law; docs/observability.md)")
     controller = build_controller(args)
     if controller is not None \
             and args.engine not in CONTROLLER_ENGINES:
@@ -290,7 +311,8 @@ def build_engine(args, sc, link):
                          insert=getattr(args, "insert", None),
                          insert_cap=getattr(args, "insert_cap", None),
                          controller=controller,
-                         verify=verify)
+                         verify=verify, record=record,
+                         record_cap=record_cap)
     if args.engine == "sharded-batched":
         from .interp.jax_engine.sharded import (ShardedBatchedEngine,
                                                 make_mesh)
@@ -299,7 +321,7 @@ def build_engine(args, sc, link):
             batch=batch, seed=args.seed, window=args.window,
             route_cap=args.route_cap, lint=args.lint, faults=faults,
             telemetry=telemetry, controller=controller,
-            verify=verify)
+            verify=verify, record=record, record_cap=record_cap)
     if args.engine == "fused-sparse":
         from .interp.jax_engine.fused_sparse import FusedSparseEngine
         kw = {} if args.max_batch is None else {
@@ -309,14 +331,16 @@ def build_engine(args, sc, link):
                                  record_events=args.record_events,
                                  lint=args.lint, telemetry=telemetry,
                                  controller=controller,
-                                 verify=verify,
+                                 verify=verify, record=record,
+                                 record_cap=record_cap,
                                  **kw)
     if args.engine == "edge":
         from .interp.jax_engine.edge_engine import EdgeEngine
         return EdgeEngine(sc, link, seed=args.seed, cap=args.edge_cap,
                           lint=args.lint, faults=faults,
                           telemetry=telemetry, controller=controller,
-                          verify=verify)
+                          verify=verify, record=record,
+                          record_cap=record_cap)
     if args.engine in ("sharded", "sharded-edge", "sharded-fused"):
         from .interp.jax_engine.sharded import (
             ShardedEdgeEngine, ShardedEngine,
@@ -485,6 +509,12 @@ def main(argv=None) -> int:
     if argv and argv[0] == "profile":
         # full-telemetry run + Perfetto trace (docs/observability.md)
         return profile_main(argv[1:])
+    if argv and argv[0] == "explain":
+        # causal queries over a recorded flight log (obs/query.py)
+        return explain_main(argv[1:])
+    if argv and argv[0] == "bisect":
+        # divergence bisection between two runs (obs/bisect.py)
+        return bisect_main(argv[1:])
     p = argparse.ArgumentParser(
         prog="timewarp_tpu",
         description="Run a distributed-system scenario under an "
@@ -622,6 +652,28 @@ def main(argv=None) -> int:
                         "writing to this log dir (view with xprof/"
                         "TensorBoard); degrades to a warning when "
                         "profiling is unavailable")
+    p.add_argument("--record", default="off",
+                   choices=["off", "deliveries", "full"],
+                   help="causal flight recorder (obs/flight.py, "
+                        "docs/observability.md): a bounded per-"
+                        "superstep event plane through the jitted "
+                        "scan — bit-exact, and 'off' lowers to the "
+                        "exact record-free program. 'deliveries' = "
+                        "one event per delivered message; 'full' = + "
+                        "sends and fault actions (defer/cut/down/"
+                        "purge/restart) — the input of `timewarp-tpu "
+                        "explain` and the event side of `bisect`")
+    p.add_argument("--record-cap", type=int, default=None,
+                   help="flight-recorder events per superstep "
+                        "(default 256); the excess is dropped but "
+                        "counted, never silent")
+    p.add_argument("--record-out", default=None,
+                   help="drain the recorded events to this JSONL "
+                        "event log (METRICS_SCHEMA event lines, "
+                        "name=flight; needs --record; validate with "
+                        "`python -m timewarp_tpu.obs.metrics "
+                        "validate`, query with `timewarp-tpu "
+                        "explain`)")
     p.add_argument("--verify", default="off",
                    choices=["off", "guard", "digest", "shadow"],
                    help="online state-integrity checking (integrity/, "
@@ -653,6 +705,16 @@ def main(argv=None) -> int:
         raise SystemExit(
             "--metrics-out/--trace-out need --telemetry counters|full "
             "(off-mode engines record nothing, by contract)")
+    if args.record_out and args.record == "off":
+        raise SystemExit(
+            "--record-out drains the flight recorder's event log; "
+            "pass --record deliveries|full (off-mode engines record "
+            "nothing, by contract)")
+    if args.record_cap is not None and args.record == "off":
+        raise SystemExit(
+            "--record-cap sizes the flight recorder's per-superstep "
+            "event plane; pass --record deliveries|full (the knob "
+            "would be silently ignored)")
     if args.decisions_out and args.controller == "off":
         raise SystemExit("--decisions-out needs --controller "
                          "auto|replay:* (static runs decide nothing)")
@@ -778,6 +840,17 @@ def main(argv=None) -> int:
             engine.metrics = MetricsRegistry(
                 path=args.metrics_out,
                 run=engine.metrics_label)
+        if args.record_out:
+            # attach BEFORE the run, like the metrics registry: the
+            # chunked drivers drain each committed chunk's events as
+            # they happen (run_verified drains only VERIFIED chunks —
+            # a rolled-back chunk's events never reach the log)
+            from .obs.flight import FlightWriter
+            # truncate: a re-run must replace the log, not append a
+            # second run's events onto it (solo lines carry no run_id
+            # to disambiguate the merge by)
+            engine.flight_out = FlightWriter(args.record_out,
+                                             truncate=True)
         from .obs.profiler import profile_session
         with profile_session(args.jax_profile):
             if engine.controller is not None:
@@ -875,6 +948,25 @@ def main(argv=None) -> int:
                    **final_info}
     if args.telemetry != "off":
         summary.update(_export_telemetry(args, sc, engine, trace))
+    if args.record != "off":
+        # the flight-recorder receipt: event/drop counts per run (per
+        # world, batched) — a dropped count > 0 says the log is
+        # incomplete and names the fix (--record-cap)
+        log = getattr(engine, "last_run_flight", None)
+        fo = getattr(engine, "flight_out", None)
+        if fo is not None:
+            fo.close()
+        if isinstance(log, list):
+            summary["flight"] = {"mode": args.record,
+                                 "events": [len(lg) for lg in log],
+                                 "dropped": [lg.dropped for lg in log]}
+        else:
+            summary["flight"] = {
+                "mode": args.record,
+                "events": 0 if log is None else len(log),
+                "dropped": 0 if log is None else log.dropped}
+        if args.record_out:
+            summary["flight"]["out"] = args.record_out
     if args.verify != "off":
         ri = getattr(engine, "last_run_integrity", None)
         summary["integrity"] = {"mode": args.verify} if ri is None \
@@ -938,6 +1030,202 @@ def _export_telemetry(args, sc, engine, trace) -> dict:
         tb.compile_marks(label, stats["compiles"])
         info["trace"] = tb.save(args.trace_out)
     return info
+
+
+def explain_main(argv) -> int:
+    """``timewarp-tpu explain EVENTS.jsonl --dst N``: reconstruct a
+    delivery's causal chain from a recorded flight log (obs/query.py,
+    docs/observability.md "Causal queries") — which send produced it,
+    which fault windows deferred/degraded it along the way — and
+    optionally draw the log's send→deliver arrows onto a Perfetto
+    trace."""
+    p = argparse.ArgumentParser(
+        prog="timewarp-tpu explain",
+        description="Reconstruct a delivery's causal chain from a "
+                    "flight-recorder event log (--record-out).")
+    p.add_argument("events", help="JSONL event log written by "
+                                  "--record-out / sweep --record")
+    p.add_argument("--dst", type=int, required=True,
+                   help="destination node of the delivery to explain")
+    p.add_argument("--t-us", type=int, default=None,
+                   help="the delivery's due instant (µs); unset = "
+                        "the --nth matching delivery")
+    p.add_argument("--src", type=int, default=None,
+                   help="restrict to deliveries from this source")
+    p.add_argument("--nth", type=int, default=0,
+                   help="which matching delivery (0-based, log order)")
+    p.add_argument("--world", type=int, default=None,
+                   help="world filter for batched/sweep logs")
+    p.add_argument("--run-id", default=None,
+                   help="run_id filter for sweep event logs")
+    p.add_argument("--faults", default=None,
+                   help="the run's --faults schedule, for the "
+                        "fault-window cross-reference")
+    p.add_argument("--flows", default=None,
+                   help="also write a Perfetto trace with the log's "
+                        "send->deliver flow arrows to this file")
+    p.add_argument("--json", action="store_true",
+                   help="one JSON chain instead of text lines")
+    args = p.parse_args(argv)
+    from .obs.flight import load_flight_jsonl
+    from .obs.query import (add_flight_flows, chain_lines,
+                            explain_delivery)
+    try:
+        log = load_flight_jsonl(args.events, run_id=args.run_id,
+                                world=args.world)
+        res = explain_delivery(log, dst=args.dst, t_us=args.t_us,
+                               nth=args.nth, src=args.src,
+                               faults=args.faults)
+    except (OSError, ValueError) as e:
+        raise SystemExit(str(e)) from None
+    if args.flows:
+        from .obs import TraceBuilder
+        tb = TraceBuilder(process="timewarp-tpu explain")
+        n = add_flight_flows(tb, log)
+        res["flows"] = {"file": tb.save(args.flows), "arrows": n}
+    if args.json:
+        print(json.dumps(res))
+    else:
+        for line in chain_lines(res):
+            print(line)
+        if "flows" in res:
+            print(f"flows   {res['flows']['arrows']} arrows -> "
+                  f"{res['flows']['file']} (open at ui.perfetto.dev)")
+    return 0
+
+
+def bisect_main(argv) -> int:
+    """``timewarp-tpu bisect FAMILY``: binary-search two divergent
+    runs' per-chunk digest chains to the first diverging chunk, re-run
+    that chunk with the flight recorder on, and name the first
+    diverging superstep, field, and message-event delta in one pinned
+    diagnostic line (obs/bisect.py, docs/observability.md). Two
+    comparison forms: ``--inject-flip`` pits a deterministically
+    corrupted run against the clean run (the integrity detection
+    law's debugging half); ``--engine-b`` pits two engines against
+    each other (trace-chain basis — state layouts legitimately
+    differ)."""
+    p = argparse.ArgumentParser(
+        prog="timewarp-tpu bisect",
+        description="Locate the first diverging chunk/superstep/"
+                    "field between two runs of one config.")
+    p.add_argument("scenario",
+                   choices=["token-ring", "gossip", "praos",
+                            "ping-pong"])
+    p.add_argument("--engine", default="general",
+                   choices=["general", "edge", "fused-sparse"])
+    p.add_argument("--engine-b", default=None,
+                   choices=["general", "edge", "fused-sparse"],
+                   help="compare --engine against THIS engine "
+                        "(default: same engine — needs "
+                        "--inject-flip to have anything to find)")
+    p.add_argument("--inject-flip", default=None,
+                   help="corrupt run B deterministically: "
+                        "flip:SEED[:CHUNK[:PLANE]] "
+                        "(integrity/inject.py grammar)")
+    p.add_argument("--nodes", type=int, default=64)
+    p.add_argument("--steps", type=int, default=1000)
+    p.add_argument("--chunk", type=int, default=64,
+                   help="bisection chunk granularity (supersteps)")
+    p.add_argument("--link", default="uniform:1000:5000")
+    p.add_argument("--faults", default=None)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--window", type=_window_arg, default=1)
+    p.add_argument("--record-cap", type=int, default=4096,
+                   help="event capacity per superstep for the "
+                        "diverging chunk's recorded re-run")
+    p.add_argument("--mailbox-cap", type=int, default=8)
+    p.add_argument("--edge-cap", type=int, default=2)
+    p.add_argument("--tokens", type=int, default=None)
+    p.add_argument("--think-us", type=int, default=3_000_000)
+    p.add_argument("--end-us", type=int, default=20_000_000)
+    p.add_argument("--observer", action="store_true")
+    p.add_argument("--steady", action="store_true")
+    p.add_argument("--burst", action="store_true")
+    p.add_argument("--fanout", type=int, default=8)
+    p.add_argument("--slots", type=int, default=10)
+    p.add_argument("--leader-prob", type=float, default=0.05)
+    p.add_argument("--json", action="store_true")
+    args = p.parse_args(argv)
+    engine_b = args.engine_b or args.engine
+    if args.engine_b is None and not args.inject_flip:
+        raise SystemExit(
+            "nothing to bisect: the two sides are the same "
+            "deterministic run — pass --inject-flip flip:SEED[:CHUNK"
+            "[:PLANE]] (corrupt vs clean) or --engine-b ENGINE "
+            "(engine vs engine)")
+    if args.engine_b is not None and args.inject_flip:
+        raise SystemExit(
+            "--engine-b and --inject-flip are mutually exclusive: a "
+            "cross-engine comparison must chain trace rows (state "
+            "layouts legitimately differ), but a flip can land in a "
+            "plane trace rows never observe (a payload word) and "
+            "would read as a clean all-clear — bisect corrupt vs "
+            "clean on ONE engine (the state basis sees every plane), "
+            "or engine vs engine without the flip")
+    sc = build_scenario(args)
+    link = parse_link(args.link)
+    faults = build_faults(args)
+
+    def factory(engine_name):
+        def make(record="off"):
+            if engine_name == "general":
+                from .interp.jax_engine.engine import JaxEngine
+                return JaxEngine(sc, link, seed=args.seed,
+                                 window=args.window, faults=faults,
+                                 lint="off", record=record,
+                                 record_cap=args.record_cap)
+            if engine_name == "edge":
+                from .interp.jax_engine.edge_engine import EdgeEngine
+                return EdgeEngine(sc, link, seed=args.seed,
+                                  cap=args.edge_cap, faults=faults,
+                                  lint="off", record=record,
+                                  record_cap=args.record_cap)
+            from .interp.jax_engine.fused_sparse import \
+                FusedSparseEngine
+            if faults is not None:
+                raise SystemExit(
+                    "fused-sparse has no fault masks (the kernels "
+                    "bypass the mask points); drop --faults or "
+                    "bisect the general engine")
+            return FusedSparseEngine(sc, link, seed=args.seed,
+                                     window=args.window, lint="off",
+                                     record=record,
+                                     record_cap=args.record_cap)
+        return make
+
+    inject_b = None
+    if args.inject_flip:
+        from .integrity import FlipInjector
+        spec = args.inject_flip
+        try:
+            FlipInjector(spec)   # grammar check BEFORE any run
+        except ValueError as e:
+            raise SystemExit(str(e)) from None
+        def inject_b():  # noqa: F811 — the factory form bisect wants
+            return FlipInjector(spec)
+    from .obs.bisect import bisect_engines
+    names = ((args.engine, engine_b) if args.engine_b
+             else ("clean", "corrupt"))
+    try:
+        rep = bisect_engines(
+            factory(args.engine), factory(engine_b), args.steps,
+            chunk=args.chunk, names=names, inject_b=inject_b,
+            basis="trace" if args.engine_b else "state")
+    except ValueError as e:
+        raise SystemExit(str(e)) from None
+    if rep is None:
+        detail = f"{names[0]} == {names[1]} at every chunk boundary"
+        if args.json:
+            print(json.dumps({"divergence": None, "detail": detail}))
+        else:
+            print(detail)
+        return 1
+    if args.json:
+        print(json.dumps({"divergence": rep.to_json()}))
+    else:
+        print(rep.line())
+    return 0
 
 
 def profile_main(argv) -> int:

@@ -100,15 +100,19 @@ class VerifiedRunMixin:
         chunk's host-side artifacts (stats, telemetry, metrics
         stream) are shielded — the shadow is a check, not a run."""
         saved = (self.last_run_stats, self.last_run_telemetry,
-                 getattr(self, "metrics", None))
+                 getattr(self, "metrics", None),
+                 getattr(self, "last_run_flight", None),
+                 getattr(self, "flight_out", None))
         self.metrics = None
+        self.flight_out = None
         self._pad_mult = 2
         try:
             fin, _ = self.run(budget, state=pre_state)
         finally:
             self._pad_mult = 1
             (self.last_run_stats, self.last_run_telemetry,
-             self.metrics) = saved
+             self.metrics, self.last_run_flight,
+             self.flight_out) = saved
         return fin
 
     # -- the driver ------------------------------------------------------
@@ -148,8 +152,9 @@ class VerifiedRunMixin:
         st = state if state is not None else self.init_state()
         start = np.asarray(_get(st.steps), np.int64)
         rows = [[] for _ in range(nworld)]
-        chunk_stats, frame_chunks = [], []
+        chunk_stats, frame_chunks, flight_chunks = [], [], []
         self.last_run_telemetry = None
+        self.last_run_flight = None
         # cleared at entry: a run that RAISES (persistent corruption)
         # must not leave a previous run's record for callers to
         # misattribute
@@ -247,13 +252,16 @@ class VerifiedRunMixin:
                                   np.minimum(remaining, chunk), 0)
             else:
                 budget = int(min(int(remaining), chunk))
-            # shield the metrics stream while the chunk runs: run()
-            # flushes its `supersteps` lines internally, but THIS
+            # shield the metrics stream AND the flight-event log
+            # while the chunk runs: run() flushes its `supersteps`
+            # lines (and drains recorded events) internally, but THIS
             # chunk is unverified — a chunk that fails the guard or
             # the shadow compare would leave tainted (and, after the
             # re-run, duplicated) lines behind. The flush happens at
             # commit below, once the chunk is verified.
             self.metrics = None
+            fout, self.flight_out = getattr(self, "flight_out",
+                                            None), None
             try:
                 st, tr = self.run(budget, state=st)
             except IntegrityViolation as e:
@@ -262,7 +270,9 @@ class VerifiedRunMixin:
                 continue
             finally:
                 self.metrics = metrics
+                self.flight_out = fout
             pstats, ptele = self.last_run_stats, self.last_run_telemetry
+            pflight = self.last_run_flight
             dp = None   # post-chunk digest, reused at commit when the
             #           # shadow compare already paid for it
             if mode == "shadow" and due:
@@ -289,8 +299,17 @@ class VerifiedRunMixin:
             # stream, exactly the lines run() would have flushed)
             chunk_stats.append(pstats)
             frame_chunks.append(ptele)
+            flight_chunks.append(pflight)
             if metrics is not None and ptele is not None:
                 metrics.superstep_chunk(self.metrics_label, ptele)
+            if fout is not None and pflight is not None:
+                # drain the VERIFIED chunk's events only — a rolled-
+                # back chunk's events never reach the log
+                if isinstance(pflight, list):
+                    for b, lg in enumerate(pflight):
+                        fout.write(lg, world=b)
+                else:
+                    fout.write(pflight)
             if batch is not None:
                 for b in range(nworld):
                     rows[b].extend(tr[b].row(i)
@@ -329,6 +348,9 @@ class VerifiedRunMixin:
         if self.telemetry != "off":
             from ..obs.telemetry import concat_frames
             self.last_run_telemetry = concat_frames(frame_chunks)
+        if getattr(self, "record", "off") != "off":
+            from ..obs.flight import concat_flight
+            self.last_run_flight = concat_flight(flight_chunks)
         self.last_run_integrity = {
             "mode": mode, "chunks": ci, "checks": checks,
             "rollbacks": rollbacks, "violations": violations,

@@ -101,8 +101,14 @@ def padded_scan(step_all, st, n_pad: int, max_steps):
                 if per_world else run
             return jnp.where(r, b, a)
         carry = jax.tree.map(mask, carry, new)
+        # the same per-world trailing-dim broadcast for the trace row:
+        # [B]-leading y leaves may carry plane dims beyond B (the
+        # telemetry full mode's per-node columns, the flight
+        # recorder's [B, R] event plane)
         y = jax.tree.map(
-            lambda x: jnp.where(run, x, jnp.zeros_like(x)), y)
+            lambda x: jnp.where(
+                run.reshape(run.shape + (1,) * (x.ndim - 1))
+                if per_world else run, x, jnp.zeros_like(x)), y)
         return carry, y
     return jax.lax.scan(body, st, jnp.arange(n_pad, dtype=jnp.int64))
 
@@ -119,7 +125,12 @@ class StepOut(NamedTuple):
     ``integ`` is the state-integrity guard plane (integrity/checks.py
     ``IntegrityRow``) — ``None`` unless ``verify != "off"``; the same
     None-default contract, so the verify-off jaxpr is byte-identical
-    to the pre-knob engine (tests/test_zzzzintegrity.py)."""
+    to the pre-knob engine (tests/test_zzzzintegrity.py).
+
+    ``rec`` is the causal flight recorder's bounded event plane
+    (obs/flight.py ``RecordRow``) — ``None`` unless ``record !=
+    "off"``; the same None-default contract again
+    (tests/test_zzzzzflight.py)."""
     valid: jax.Array
     t: jax.Array
     fired_count: jax.Array
@@ -131,6 +142,7 @@ class StepOut(NamedTuple):
     overflow: jax.Array
     telem: Any = None
     integ: Any = None
+    rec: Any = None
 
 
 class LocalComm:

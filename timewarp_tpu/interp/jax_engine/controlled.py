@@ -134,6 +134,7 @@ class ControlledRunMixin:
             else []
         chunk_stats = []
         frame_chunks = []
+        flight_chunks = []
         self.last_run_telemetry = None
         ci = 0
         while True:
@@ -172,6 +173,7 @@ class ControlledRunMixin:
                 rows.extend(tr.row(i) for i in range(len(tr)))
             chunk_stats.append(self.last_run_stats)
             frame_chunks.append(self.last_run_telemetry)
+            flight_chunks.append(self.last_run_flight)
             ci += 1
         if chunk_stats:
             self._stats_merge(chunk_stats)
@@ -182,6 +184,11 @@ class ControlledRunMixin:
             # views already
             from ...obs.telemetry import concat_frames
             self.last_run_telemetry = concat_frames(frame_chunks)
+        if getattr(self, "record", "off") != "off":
+            # same whole-run contract for the flight log (indices are
+            # run-global already — each chunk drained as it committed)
+            from ...obs.flight import concat_flight
+            self.last_run_flight = concat_flight(flight_chunks)
         self.last_run_decisions = ctrl.decisions
         if batch is not None:
             return st, [SuperstepTrace.from_rows(r) for r in rows]

@@ -63,6 +63,7 @@ from .common import padded_scan, scan_pad
 from .controlled import ControlledRunMixin
 from .common import thi as _thi, tlo as _tlo, u32sum as _u32sum
 from ...integrity.runner import VerifiedRunMixin
+from ...obs.flight import FlightRecorderMixin
 
 __all__ = ["EdgeEngine", "EdgeState", "EdgeTopology"]
 
@@ -191,7 +192,8 @@ class EdgeState(NamedTuple):
     restart_done: jax.Array
 
 
-class EdgeEngine(RunStatsMixin, ControlledRunMixin, VerifiedRunMixin):
+class EdgeEngine(RunStatsMixin, ControlledRunMixin, VerifiedRunMixin,
+                 FlightRecorderMixin):
     """Batched engine for static-topology scenarios. Same driver API as
     :class:`~timewarp_tpu.interp.jax_engine.engine.JaxEngine`: ``run``
     (traced, per-superstep rows) and ``run_quiet`` (while_loop, no
@@ -204,7 +206,8 @@ class EdgeEngine(RunStatsMixin, ControlledRunMixin, VerifiedRunMixin):
                  seed: int = 0, cap: int = 2,
                  lint: str = "warn", faults=None,
                  telemetry: str = "off", controller=None,
-                 verify: str = "off") -> None:
+                 verify: str = "off", record: str = "off",
+                 record_cap: Optional[int] = None) -> None:
         # static scenario sanitizer — same knob contract as JaxEngine
         from ...analysis import check_scenario
         from ...obs.telemetry import validate_mode
@@ -212,6 +215,9 @@ class EdgeEngine(RunStatsMixin, ControlledRunMixin, VerifiedRunMixin):
         # state-integrity checking — same knob contract as JaxEngine
         # (integrity/, docs/integrity.md)
         self._bind_verify(verify)
+        # causal flight recorder — same knob contract as JaxEngine
+        # (obs/flight.py, docs/observability.md)
+        self._bind_record(record, record_cap)
         self.metrics = None
         self.metrics_label = type(self).__name__
         self.last_run_telemetry = None
@@ -309,6 +315,11 @@ class EdgeEngine(RunStatsMixin, ControlledRunMixin, VerifiedRunMixin):
         W = E * C
         node_ids = comm.node_ids()  # global identities, int32[n]
         base = st.time
+        #: flight-recorder side channels (obs/flight.py; the JaxEngine
+        #: twin): per-trace compacted event buffers, merged into the
+        #: StepOut event plane below
+        self._rec_extra = []
+        rec_full = with_trace and self.record == "full"
 
         # validity is the rel sentinel (I32MAX = empty slot)
         q_live = st.q_rel < _I32MAX                          # [E,C,N]
@@ -323,8 +334,19 @@ class EdgeEngine(RunStatsMixin, ControlledRunMixin, VerifiedRunMixin):
             # crash suppression + injected restarts (faults/apply.py;
             # same masks as JaxEngine)
             from ...faults.apply import defer_next
+            node_next_pre = node_next
             node_next = defer_next(self._ft, node_ids, node_next,
                                    st.restart_done)
+            if rec_full:
+                # fault action: crash window slid a pending event
+                # later (engine.py's defer capture, identically)
+                from ...obs import flight as _flight
+                dm = (node_next > node_next_pre) \
+                    & (node_next_pre < NEVER)
+                self._rec_extra.append(_flight.compact(
+                    self.record_cap, _flight.EV_FAULT, dm, node_ids,
+                    node_ids, node_next_pre, node_next,
+                    _flight.TAG_DEFER))
         t = comm.all_min(node_next.min())
         live = t < NEVER
         fire = (node_next == t) & live
@@ -353,6 +375,14 @@ class EdgeEngine(RunStatsMixin, ControlledRunMixin, VerifiedRunMixin):
                     reset_now.reshape((n,) + (1,) * (cur.ndim - 1)),
                     init, cur),
                 st.states, self._reset_states)
+            if rec_full:
+                # the injected reboot firing (purged entries are
+                # captured below, once per-edge sender ids exist)
+                from ...obs import flight as _flight
+                self._rec_extra.append(_flight.compact(
+                    self.record_cap, _flight.EV_FAULT, reset_now,
+                    node_ids, node_ids, jnp.int64(-1), now_vec,
+                    _flight.TAG_RESTART))
 
         # 2. deliverable messages (all per-edge slots due at fired nodes)
         shift32 = jnp.minimum(t - base,
@@ -377,6 +407,22 @@ class EdgeEngine(RunStatsMixin, ControlledRunMixin, VerifiedRunMixin):
         isrc = jnp.broadcast_to(
             src_rows[:, None, :], (E, C, n)).reshape(W, n)
         ipay = st.q_pay.reshape(W, P, n)
+        if rec_full and purge is not None:
+            # purged queue entries (reboot memory loss), now that the
+            # per-edge sender ids exist — src/deliver-time identify
+            # the lost message
+            from ...obs import flight as _flight
+            self._rec_extra.append(_flight.compact(
+                self.record_cap, _flight.EV_FAULT,
+                purge.transpose(2, 0, 1),
+                jnp.broadcast_to(src_rows[:, None, :],
+                                 (E, C, n)).transpose(2, 0, 1)
+                if sc.inbox_src else jnp.int32(0),
+                jnp.broadcast_to(node_ids[None, None, :],
+                                 (E, C, n)).transpose(2, 0, 1),
+                jnp.int64(-1),
+                st.q_rel.transpose(2, 0, 1),
+                _flight.TAG_PURGE, t_off=base))
         if not sc.commutative_inbox:
             # contract #2 order: (deliver_time, insert_step, src, slot)
             # — the oracle's arrival order is chronological routing
@@ -489,7 +535,20 @@ class EdgeEngine(RunStatsMixin, ControlledRunMixin, VerifiedRunMixin):
                     t + jnp.maximum(delay, jnp.int64(1)))
                 fault_step = fault_step + comm.all_sum(
                     jnp.sum(cutm | downm, dtype=jnp.int32))
+                if rec_full:
+                    # per-edge flight capture (obs/flight.py): the
+                    # cut, then the sends with down-dropped ones
+                    # re-tagged — the shared mixin helpers, at edge
+                    # width
+                    self._rec_cut(rec_full, cutm, src_e, node_ids, t)
+                    self._rec_extra.append(self._rec_sends(
+                        ok & ~cutm, downm, src_e, node_ids, t,
+                        t + jnp.maximum(delay, jnp.int64(1))))
                 ok = ok & ~cutm & ~downm
+            elif rec_full:
+                self._rec_extra.append(self._rec_sends(
+                    ok, None, src_e, node_ids, t,
+                    t + jnp.maximum(delay, jnp.int64(1))))
             drel64 = jnp.maximum(delay, jnp.int64(1))       # contract #4
             # queue times are int32-relative; a >= 2^31 µs delay cannot
             # be represented — clamp and count, never wrap silently
@@ -551,6 +610,33 @@ class EdgeEngine(RunStatsMixin, ControlledRunMixin, VerifiedRunMixin):
             RECV, jnp.broadcast_to(node_ids, (E, C, n)),
             rsrc, _tlo(d_abs), _thi(d_abs), st.q_pay[:, :, 0, :])
         recv_hash = comm.all_sum(_u32sum(jnp.where(deliver, rmix, 0)))
+        rec = None
+        if self.record != "off" and with_trace:
+            # the flight-recorder event plane (engine.py's twin):
+            # deliveries node-major over the [E, C] queue axes, then
+            # the capture buffers in superstep order
+            from ...obs import flight as _flight
+            d_src = (jnp.broadcast_to(src_rows[:, None, :],
+                                      (E, C, n)).transpose(2, 0, 1)
+                     if sc.inbox_src else jnp.int32(0))
+            d_dst = jnp.broadcast_to(node_ids[None, None, :],
+                                     (E, C, n)).transpose(2, 0, 1)
+            if self.record == "deliveries":
+                # slim fast path (engine.py's twin): one compaction,
+                # constant planes elided
+                rec = _flight.record_deliveries(
+                    self.record_cap, deliver.transpose(2, 0, 1),
+                    d_src, d_dst, st.q_rel.transpose(2, 0, 1),
+                    t_off=base)
+            else:
+                row = _flight.record_masked(
+                    _flight.empty_row(self.record_cap),
+                    _flight.EV_DELIVER, deliver.transpose(2, 0, 1),
+                    d_src, d_dst, jnp.int64(-1),
+                    st.q_rel.transpose(2, 0, 1), 0, t_off=base)
+                for comp in self._rec_extra:
+                    row = _flight.record_compacted(row, comp)
+                rec = row
         telem = None
         if self.telemetry != "off":
             telem = self._telemetry_row(wake, q_rel, t, out_valid,
@@ -577,6 +663,7 @@ class EdgeEngine(RunStatsMixin, ControlledRunMixin, VerifiedRunMixin):
             overflow=overflow_step,
             telem=telem,
             integ=integ,
+            rec=rec,
         )
         yrow = jax.tree.map(
             lambda x: jnp.where(live, x, jnp.zeros_like(x)), yrow)
@@ -687,6 +774,7 @@ class EdgeEngine(RunStatsMixin, ControlledRunMixin, VerifiedRunMixin):
                                    jnp.asarray(max_steps, jnp.int64))
         ys = jax.device_get(ys)
         self._stats_end(begin, st.steps, final.steps)
+        self._capture_flight(ys, st)
         self._capture_integrity(ys)
         self.last_run_telemetry = None
         if self.telemetry != "off" and ys.telem is not None:

@@ -55,6 +55,7 @@ from .common import padded_scan, scan_pad as _scan_pad
 from .common import thi as _thi, tlo as _tlo, u32sum as _u32sum
 from .controlled import ControlledRunMixin
 from ...integrity.runner import VerifiedRunMixin
+from ...obs.flight import FlightRecorderMixin
 
 __all__ = ["JaxEngine", "EngineState", "BatchSpec"]
 
@@ -115,7 +116,8 @@ class EngineState(NamedTuple):
     restart_done: jax.Array
 
 
-class JaxEngine(RunStatsMixin, ControlledRunMixin, VerifiedRunMixin):
+class JaxEngine(RunStatsMixin, ControlledRunMixin, VerifiedRunMixin,
+                FlightRecorderMixin):
     """Single-chip batched engine for arbitrary (dynamic-destination)
     scenarios. ``run(max_steps)`` executes up to ``max_steps``
     supersteps under one ``lax.scan`` and returns the final
@@ -259,7 +261,9 @@ class JaxEngine(RunStatsMixin, ControlledRunMixin, VerifiedRunMixin):
                  insert: Optional[str] = None,
                  insert_cap: Optional[int] = None,
                  controller=None,
-                 verify: str = "off") -> None:
+                 verify: str = "off",
+                 record: str = "off",
+                 record_cap: Optional[int] = None) -> None:
         # static scenario sanitizer (analysis/): "warn" logs findings,
         # "error" refuses to construct on contract violations, "off"
         # skips entirely (bit-for-bit the pre-lint behavior — the
@@ -280,6 +284,13 @@ class JaxEngine(RunStatsMixin, ControlledRunMixin, VerifiedRunMixin):
         # per-chunk state digest / pow2-twin re-execution in the
         # run_verified driver (integrity/runner.py)
         self._bind_verify(verify)
+        # the causal flight recorder (obs/flight.py,
+        # docs/observability.md): "off" lowers to the exact
+        # record-free jaxpr (the event plane is a None StepOut field,
+        # like telemetry); "deliveries" records one event per
+        # delivered message; "full" adds sends and fault actions
+        # (defer/cut/down/purge/restart)
+        self._bind_record(record, record_cap)
         #: attachable obs.metrics.MetricsRegistry: when set, every
         #: traced run flushes one aggregated `supersteps` line (per
         #: world, batched) under `metrics_label`
@@ -739,6 +750,7 @@ class JaxEngine(RunStatsMixin, ControlledRunMixin, VerifiedRunMixin):
         n = self.comm.n_local
         n_glob = self.comm.n_global
         W = self.window
+        rec_full = with_trace and self.record == "full"
         # pack (validity, destination-range check) into ONE array so
         # the per-rung gather moves 1 + P arrays instead of 3 + P —
         # random-access volume is the branch's dominant cost on this
@@ -757,6 +769,8 @@ class JaxEngine(RunStatsMixin, ControlledRunMixin, VerifiedRunMixin):
             cutm = (pdst >= 0) & cut_mask(
                 self._ft, node_ids[None, :], pdst, now_vec[None, :])
             fault_cut = jnp.sum(cutm, dtype=jnp.int32)
+            self._rec_cut(rec_full, cutm, node_ids[None, :], pdst,
+                          now_vec[None, :])
             pdst = jnp.where(cutm, jnp.int32(-1), pdst)
         sender_live = jnp.any(pdst >= 0, axis=0)                # [N]
         n_active = jnp.sum(sender_live, dtype=jnp.int32)
@@ -835,9 +849,16 @@ class JaxEngine(RunStatsMixin, ControlledRunMixin, VerifiedRunMixin):
                 mrel, msrc, mpay, overflow_step = self._insert_sorted(
                     mb_rel, mb_src, mb_payload, sd, ok_s, drel_s,
                     src_s, pay_s, free_rows, counts)
-                return (mrel, msrc, mpay, overflow_step, bad_dst_step,
-                        bad_delay_step, short_step, jnp.int32(0),
-                        sent_count, sent_hash, fault_cut + fault_down)
+                ret = (mrel, msrc, mpay, overflow_step, bad_dst_step,
+                       bad_delay_step, short_step, jnp.int32(0),
+                       sent_count, sent_hash, fault_cut + fault_down)
+                if rec_full:
+                    # send capture rides the switch return (the one
+                    # legal exit for a branch-scoped value) — pre-down
+                    # mask, so down-dropped sends are tagged, not lost
+                    ret += (self._rec_sends(ok, downm, src_l, dst_f,
+                                            tmsg_l, tmsg_l + flight),)
+                return ret
             if self._faulted:
                 return branch_faulted
 
@@ -882,9 +903,14 @@ class JaxEngine(RunStatsMixin, ControlledRunMixin, VerifiedRunMixin):
                 # route_drop ≡ 0 here (the top rung is always n); the
                 # slot exists so fused_sparse.py's override can report
                 # its VMEM batch-cap drops through the same call site
-                return (mrel, msrc, mpay, overflow_step, bad_dst_step,
-                        bad_delay_step, short_step, jnp.int32(0),
-                        sent_count, sent_hash)
+                ret = (mrel, msrc, mpay, overflow_step, bad_dst_step,
+                       bad_delay_step, short_step, jnp.int32(0),
+                       sent_count, sent_hash)
+                if rec_full:
+                    ret += (self._rec_sends(ok_s, None, src_s, sd,
+                                            tmsg_s,
+                                            tmsg_s + flight_s),)
+                return ret
             return branch
 
         rungs = self._sender_rungs(n)
@@ -937,6 +963,7 @@ class JaxEngine(RunStatsMixin, ControlledRunMixin, VerifiedRunMixin):
         n = self.comm.n_local
         n_glob = self.comm.n_global
         W = self.window
+        rec_full = with_trace and self.record == "full"
         stage = self._pallas_stage
         if self.telemetry != "off":
             # the pallas path's "rung" is its static compacted batch
@@ -958,6 +985,8 @@ class JaxEngine(RunStatsMixin, ControlledRunMixin, VerifiedRunMixin):
             cutm = (pdst >= 0) & cut_mask(
                 self._ft, node_ids[None, :], pdst, now_vec[None, :])
             fault_cut = jnp.sum(cutm, dtype=jnp.int32)
+            self._rec_cut(rec_full, cutm, node_ids[None, :], pdst,
+                          now_vec[None, :])
             pdst = jnp.where(cutm, jnp.int32(-1), pdst)
         woff_n = (now_vec - t).astype(jnp.int32)                # [N]
 
@@ -1008,9 +1037,13 @@ class JaxEngine(RunStatsMixin, ControlledRunMixin, VerifiedRunMixin):
             mrel, msrc, mpay, overflow_step = self._insert_sorted(
                 mb_rel, mb_src, mb_payload, sd, ok_s, drel_s,
                 src_s, pay_s, free_rows, counts)
-            return (mrel, msrc, mpay, overflow_step, bad_dst_step,
-                    bad_delay_step, short_step, route_drop_step,
-                    sent_count, sent_hash, fault_cut + fault_down)
+            ret = (mrel, msrc, mpay, overflow_step, bad_dst_step,
+                   bad_delay_step, short_step, route_drop_step,
+                   sent_count, sent_hash, fault_cut + fault_down)
+            if rec_full:
+                ret += (self._rec_sends(ok, downm, src_l, dst_f,
+                                        tmsg_l, tmsg_l + flight),)
+            return ret
 
         sort_dst = jnp.where(ok, dst_f, n)
         if W > 1:
@@ -1041,9 +1074,13 @@ class JaxEngine(RunStatsMixin, ControlledRunMixin, VerifiedRunMixin):
             sent_hash = _u32sum(jnp.where(ok_s, sent_mix, 0))
         else:
             sent_hash = jnp.uint32(0)
-        return (mrel, msrc, mpay, overflow_step, bad_dst_step,
-                bad_delay_step, short_step, route_drop_step,
-                sent_count, sent_hash)
+        ret = (mrel, msrc, mpay, overflow_step, bad_dst_step,
+               bad_delay_step, short_step, route_drop_step,
+               sent_count, sent_hash)
+        if rec_full:
+            ret += (self._rec_sends(ok_s, None, src_s, sd, tmsg_s,
+                                    tmsg_s + flight_s),)
+        return ret
 
     def _superstep(self, st: EngineState, with_trace: bool
                    ) -> Tuple[EngineState, Optional[_StepOut]]:
@@ -1053,6 +1090,14 @@ class JaxEngine(RunStatsMixin, ControlledRunMixin, VerifiedRunMixin):
         n_glob = comm.n_global
         node_ids = comm.node_ids()  # global identities, int32[n]
         base = st.time
+        #: flight-recorder side channels (obs/flight.py): compacted
+        #: event buffers the capture sites below accumulate during
+        #: this one trace, merged into the StepOut event plane by
+        #: _finish_superstep — reset per trace, like ``_t_rung``. The
+        #: quiet driver (with_trace=False) emits no rows, so nothing
+        #: is captured there (run_quiet is record-free by contract).
+        self._rec_extra = []
+        rec_full = with_trace and self.record == "full"
 
         # validity is the rel sentinel (I32MAX = empty slot)
         mb_live = st.mb_rel < _I32MAX                           # [K, N]
@@ -1069,8 +1114,22 @@ class JaxEngine(RunStatsMixin, ControlledRunMixin, VerifiedRunMixin):
             # its t_up, and unconsumed reset rows inject the restart
             # firing (faults/apply.py)
             from ...faults.apply import defer_next
+            node_next_pre = node_next
             node_next = defer_next(self._ft, node_ids, node_next,
                                    st.restart_done)
+            if rec_full:
+                # fault action: a crash window slid the node's pending
+                # event later (re-recorded every superstep the node
+                # stays down — the query layer dedups host-side).
+                # send_t carries the ORIGINAL pending instant, t the
+                # deferred-to instant (obs/flight.py docstring)
+                from ...obs import flight
+                dm = (node_next > node_next_pre) \
+                    & (node_next_pre < NEVER)
+                self._rec_extra.append(flight.compact(
+                    self.record_cap, flight.EV_FAULT, dm, node_ids,
+                    node_ids, node_next_pre, node_next,
+                    flight.TAG_DEFER))
         t = comm.all_min(node_next.min())
         live = t < NEVER
         # dynamic dispatch (controlled.py): the controller's requested
@@ -1126,6 +1185,22 @@ class JaxEngine(RunStatsMixin, ControlledRunMixin, VerifiedRunMixin):
                     reset_now.reshape((n,) + (1,) * (cur.ndim - 1)),
                     init, cur),
                 st.states, self._reset_states)
+            if rec_full:
+                # fault actions: the injected reboot firing, and every
+                # mailbox entry the reboot's memory loss purged (the
+                # purged message's src/deliver-time identify it)
+                from ...obs import flight
+                self._rec_extra.append(flight.compact(
+                    self.record_cap, flight.EV_FAULT, reset_now,
+                    node_ids, node_ids, jnp.int64(-1), now_vec,
+                    flight.TAG_RESTART))
+                self._rec_extra.append(flight.compact(
+                    self.record_cap, flight.EV_FAULT, purge,
+                    st.mb_src if sc.inbox_src
+                    else jnp.zeros_like(st.mb_src),
+                    jnp.broadcast_to(node_ids[None, :], (K, n)),
+                    jnp.int64(-1),
+                    st.mb_rel, flight.TAG_PURGE, t_off=base))
 
         # 2. deliverable messages: due at or before the node's own
         #    firing instant (== `<= shift32` when W == 1)
@@ -1271,6 +1346,12 @@ class JaxEngine(RunStatsMixin, ControlledRunMixin, VerifiedRunMixin):
             res = route(
                 out, out_valid, now_vec, t, mb_rel, mb_src,
                 mb_payload, free_rows, counts, node_ids, with_trace)
+            if rec_full:
+                # the routing tail's send-event buffer rode the
+                # return (it crosses a lax.switch boundary) — merge
+                # it into this superstep's capture order
+                self._rec_extra.append(res[-1])
+                res = res[:-1]
             (mb_rel, mb_src, mb_payload, overflow_step, bad_dst_step,
              bad_delay_step, short_step, route_drop_step, sent_count,
              sent_hash) = res[:10]
@@ -1366,6 +1447,12 @@ class JaxEngine(RunStatsMixin, ControlledRunMixin, VerifiedRunMixin):
             bad_delay_step = comm.all_sum(bad_delay_step)
             short_step = comm.all_sum(short_step)
             bucket_ovf = jnp.int32(0)
+            if rec_full:
+                # lazy path is single-chip and never faulted: the
+                # sliced survivors ARE the sent set (route_drop > 0
+                # runs are outside the parity regime by definition)
+                self._rec_extra.append(self._rec_sends(
+                    ok_s, None, src_s, sd, tmsg_s, tmsg_s + flight_s))
         else:
             mbits = msg_bits(self.s0, self.s1, src_f, dst_f, tmsg,
                              slot_f) if self.link.needs_key else None
@@ -1378,6 +1465,7 @@ class JaxEngine(RunStatsMixin, ControlledRunMixin, VerifiedRunMixin):
                 from ...faults.apply import cut_mask, degrade
                 cutm = ok & cut_mask(self._ft, src_f, dst_f, tmsg)
                 fault_eager = jnp.sum(cutm, dtype=jnp.int32)
+                self._rec_cut(rec_full, cutm, src_f, dst_f, tmsg)
                 ok = ok & ~cutm
                 delay = degrade(self._ft, delay, src_f, dst_f, tmsg)
             flight = jnp.maximum(delay, jnp.int64(1))  # contract #4
@@ -1402,9 +1490,15 @@ class JaxEngine(RunStatsMixin, ControlledRunMixin, VerifiedRunMixin):
                 # it either)
                 from ...faults.apply import down_mask
                 downm = ok & down_mask(self._ft, dst_f, t + drel64)
+                if rec_full:
+                    self._rec_extra.append(self._rec_sends(
+                        ok, downm, src_f, dst_f, tmsg, tmsg + flight))
                 fault_eager = comm.all_sum(
                     fault_eager + jnp.sum(downm, dtype=jnp.int32))
                 ok = ok & ~downm
+            elif rec_full:
+                self._rec_extra.append(self._rec_sends(
+                    ok, None, src_f, dst_f, tmsg, tmsg + flight))
 
             # 6.5. hand each message to the device that owns its
             # destination (identity single-chip; bucket + all_to_all
@@ -1565,6 +1659,35 @@ class JaxEngine(RunStatsMixin, ControlledRunMixin, VerifiedRunMixin):
             telem = self._telemetry_row(wake, mb_rel, t,
                                         route_drop_step,
                                         fault_dropped_step)
+        rec = None
+        if self.record != "off" and with_trace:
+            # the flight-recorder event plane (obs/flight.py):
+            # deliveries first (node-major, slot order — mirroring
+            # the device event ring), then the capture sites'
+            # compacted buffers in superstep order (defer, restart,
+            # purge, cuts, sends). Derived only from values this
+            # superstep already computed, so the emulation is
+            # untouched — the record exactness law
+            # (tests/test_zzzzzflight.py)
+            from ...obs import flight as _flight
+            d_src = (st.mb_src if sc.inbox_src
+                     else jnp.zeros_like(st.mb_src)).T
+            d_dst = jnp.broadcast_to(node_ids[:, None], (n, K))
+            if self.record == "deliveries":
+                # slim fast path: no fault/send captures to merge
+                # (_rec_extra only fills in full mode), so the row is
+                # one compaction with the constant planes elided
+                rec = _flight.record_deliveries(
+                    self.record_cap, deliver.T, d_src, d_dst,
+                    st.mb_rel.T, t_off=base)
+            else:
+                row = _flight.record_masked(
+                    _flight.empty_row(self.record_cap),
+                    _flight.EV_DELIVER, deliver.T, d_src, d_dst,
+                    jnp.int64(-1), st.mb_rel.T, 0, t_off=base)
+                for comp in self._rec_extra:
+                    row = _flight.record_compacted(row, comp)
+                rec = row
         integ = None
         if self.verify != "off":
             # the guard invariant plane (integrity/checks.py):
@@ -1591,6 +1714,7 @@ class JaxEngine(RunStatsMixin, ControlledRunMixin, VerifiedRunMixin):
             overflow=overflow_step,
             telem=telem,
             integ=integ,
+            rec=rec,
         )
         # mask the trace row too when not live
         yrow = jax.tree.map(
@@ -1792,6 +1916,7 @@ class JaxEngine(RunStatsMixin, ControlledRunMixin, VerifiedRunMixin):
         ys = jax.device_get(ys)
         self._stats_end(begin, st.steps, final.steps)
         self._capture_telemetry(ys)
+        self._capture_flight(ys, st)
         self._capture_integrity(ys)
         if self.batch is not None:
             return final, self._decode_traces(ys)
@@ -1922,6 +2047,7 @@ class JaxEngine(RunStatsMixin, ControlledRunMixin, VerifiedRunMixin):
         emitted = np.zeros(B, bool)
         chunk_stats = []
         frame_chunks = []
+        flight_chunks = []
         while True:
             _, remaining, active = self.fleet_progress(st, budgets,
                                                        start)
@@ -1935,6 +2061,7 @@ class JaxEngine(RunStatsMixin, ControlledRunMixin, VerifiedRunMixin):
             st, traces = self.run(vec, state=st)
             chunk_stats.append(self.last_run_stats)
             frame_chunks.append(self.last_run_telemetry)
+            flight_chunks.append(self.last_run_flight)
             if on_chunk is not None:
                 on_chunk(st, traces)
             for b in range(B):
@@ -1946,6 +2073,11 @@ class JaxEngine(RunStatsMixin, ControlledRunMixin, VerifiedRunMixin):
             # leave only its final chunk's frames behind
             from ...obs.telemetry import concat_frames
             self.last_run_telemetry = concat_frames(frame_chunks)
+        if self.record != "off":
+            # same whole-run contract for the flight log (superstep
+            # indices are already run-global — decode's offset)
+            from ...obs.flight import concat_flight
+            self.last_run_flight = concat_flight(flight_chunks)
         if chunk_stats:
             # chunk-accurate driver accounting: each run() overwrote
             # last_run_stats, so the chunked run used to report only

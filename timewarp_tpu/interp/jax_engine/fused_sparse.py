@@ -201,11 +201,13 @@ class FusedSparseEngine(JaxEngine):
                  seed: int = 0, window=1, record_events: int = 0,
                  max_batch: int = 1 << 16,
                  lint: str = "warn", telemetry: str = "off",
-                 controller=None, verify: str = "off") -> None:
+                 controller=None, verify: str = "off",
+                 record: str = "off", record_cap=None) -> None:
         super().__init__(scenario, link, seed=seed, window=window,
                          route_cap=None, record_events=record_events,
                          lint=lint, telemetry=telemetry,
-                         controller=controller, verify=verify)
+                         controller=controller, verify=verify,
+                         record=record, record_cap=record_cap)
         # the fused kernel bakes the window into its uint32 deliver
         # arithmetic and in-kernel short-delay counter, so a dispatch
         # controller adapts CHUNK LENGTH only here — window/rung ride
@@ -318,12 +320,15 @@ class FusedSparseEngine(JaxEngine):
         short_step = jnp.sum(cnts[2], dtype=jnp.int32)
 
         sent_count = kept
+        rec_full = with_trace and self.record == "full"
+        sent_hash = jnp.uint32(0)
         if with_trace:
             # the SENT digest needs per-message flight times; re-derive
             # them in XLA from the same counters (bit-identical stream
             # — entropy is keyed by message identity, not venue). Only
             # the traced `run` driver compiles this; `run_quiet`
-            # benchmarks never do.
+            # benchmarks never do. The flight recorder's send capture
+            # (obs/flight.py) rides the same re-derivation.
             ok_s = sd < n
             src_s = smrank_s // jnp.int32(M)
             tmsg_s = t + woff_s.astype(jnp.int64)
@@ -334,8 +339,10 @@ class FusedSparseEngine(JaxEngine):
             sent_mix = mix32_jnp(SENT, src_s, sd, _tlo(dt_abs),
                                  _thi(dt_abs), pay_s[0])
             sent_hash = _u32sum(jnp.where(ok_s, sent_mix, 0))
-        else:
-            sent_hash = jnp.uint32(0)
-        return (mrel, msrc, mpay, overflow_step, bad_dst_step,
-                bad_delay_step, short_step, route_drop_step,
-                sent_count, sent_hash)
+        ret = (mrel, msrc, mpay, overflow_step, bad_dst_step,
+               bad_delay_step, short_step, route_drop_step,
+               sent_count, sent_hash)
+        if rec_full:
+            ret += (self._rec_sends(ok_s, None, src_s, sd, tmsg_s,
+                                    dt_abs),)
+        return ret

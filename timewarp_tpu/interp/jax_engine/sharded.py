@@ -41,6 +41,24 @@ __all__ = ["MeshComm", "ShardedBatchedEngine", "ShardedEdgeEngine",
            "ShardedEngine", "ShardedFusedSparseEngine", "make_mesh"]
 
 
+def _refuse_record(record: str, who: str) -> str:
+    """The node-sharded engines distribute each superstep's events
+    across the mesh; the flight recorder's per-superstep event plane
+    is a single-host debug artifact (like the device event ring).
+    Refused loudly — a 1-device run of the same config records the
+    identical events by the sharding exactness law (docs/engines.md).
+    The WORLD-sharded engine records fine (each world's nodes are
+    device-local) and does not route through this guard."""
+    if record != "off":
+        raise ValueError(
+            f"{who}: record={record!r} is unsupported on the "
+            "node-sharded engines (events would be scattered across "
+            "shards); run the config on 1 device — bit-identical by "
+            "the sharding exactness law — or use ShardedBatchedEngine "
+            "for recorded fleets (docs/observability.md)")
+    return record
+
+
 class ShardedEdgeEngine(ShardedDriver, EdgeEngine):
     """Edge engine over a mesh: node axis sharded, ring delivery on
     ``ppermute``. Same ``run`` / ``run_quiet`` API as the local engine."""
@@ -48,7 +66,9 @@ class ShardedEdgeEngine(ShardedDriver, EdgeEngine):
     def __init__(self, scenario: Scenario, link: LinkModel,
                  mesh: Mesh, *, axis: AxisName = "nodes", seed: int = 0,
                  cap: int = 2, lint: str = "warn",
-                 telemetry: str = "off", verify: str = "off") -> None:
+                 telemetry: str = "off", verify: str = "off",
+                 record: str = "off") -> None:
+        _refuse_record(record, type(self).__name__)
         super().__init__(scenario, link, seed=seed, cap=cap, lint=lint,
                          telemetry=telemetry, verify=verify)
         bad = [e for e, s in enumerate(self.topo.shift) if s is None]
@@ -104,7 +124,8 @@ class ShardedEngine(ShardedDriver, JaxEngine):
                  window: int = 1,
                  route_cap: Optional[int] = None,
                  lint: str = "warn", telemetry: str = "off",
-                 verify: str = "off") -> None:
+                 verify: str = "off", record: str = "off") -> None:
+        _refuse_record(record, type(self).__name__)
         super().__init__(scenario, link, seed=seed, window=window,
                          route_cap=route_cap, lint=lint,
                          telemetry=telemetry, verify=verify)
@@ -206,11 +227,16 @@ class ShardedBatchedEngine(ShardedDriver, JaxEngine):
                  window=1, route_cap: Optional[int] = None,
                  lint: str = "warn", faults=None,
                  telemetry: str = "off", controller=None,
-                 verify: str = "off") -> None:
+                 verify: str = "off", record: str = "off",
+                 record_cap=None) -> None:
+        # the flight recorder works here: worlds are whole per device
+        # (comm stays LocalComm), and the per-world [T, B_local, R]
+        # event planes gather over the world axis like any trace leaf
         super().__init__(scenario, link, seed=seed, window=window,
                          route_cap=route_cap, lint=lint, batch=batch,
                          faults=faults, telemetry=telemetry,
-                         controller=controller, verify=verify)
+                         controller=controller, verify=verify,
+                         record=record, record_cap=record_cap)
         if batch is None:
             raise ValueError(
                 "ShardedBatchedEngine shards the world axis; it needs "
@@ -281,7 +307,9 @@ class ShardedFusedSparseEngine(ShardedEngine):
                  mesh: Mesh, *, axis: AxisName = "nodes", seed: int = 0,
                  bucket_cap: Optional[int] = None,
                  window: int = 1, lint: str = "warn",
-                 telemetry: str = "off", verify: str = "off") -> None:
+                 telemetry: str = "off", verify: str = "off",
+                 record: str = "off") -> None:
+        _refuse_record(record, type(self).__name__)
         super().__init__(scenario, link, mesh, axis=axis, seed=seed,
                          bucket_cap=bucket_cap, window=window,
                          route_cap=None, lint=lint, telemetry=telemetry,

@@ -33,14 +33,36 @@ Layers:
 - :mod:`~timewarp_tpu.obs.profiler` — optional ``jax.profiler``
   session wrapping with named annotations (degrades to a no-op when
   profiling is unavailable).
+- :mod:`~timewarp_tpu.obs.flight` — the causal flight recorder:
+  ``record="off"|"deliveries"|"full"`` on every scan-driver engine
+  threads a bounded per-superstep event plane (delivered messages;
+  full adds sends and fault actions) through the traced scan, under
+  the same zero-overhead/bit-exactness contract, drained into a
+  schema'd JSONL event log.
+- :mod:`~timewarp_tpu.obs.query` — causal queries over a recorded
+  log: reconstruct a delivery's full chain (send → fault windows →
+  delivery) and draw it as Perfetto flow arrows. CLI: ``timewarp-tpu
+  explain``.
+- :mod:`~timewarp_tpu.obs.bisect` — divergence bisection: binary-
+  search two runs' per-chunk digest chains to the first diverging
+  chunk, re-run it recorded, and report the first diverging
+  superstep, field, and event delta in one pinned line. CLI:
+  ``timewarp-tpu bisect``.
 
 docs/observability.md is the user-facing guide.
 """
 
+from .bisect import (DivergenceReport, bisect_engines, chain_bisect,
+                     first_trail_divergence)
+from .flight import (RECORD_MODES, FlightLog, FlightRecorderMixin,
+                     FlightWriter, RecordRow, concat_flight,
+                     decode_flight, load_flight_jsonl, validate_record)
 from .metrics import (METRICS_SCHEMA, MetricsRegistry, validate_line,
                       validate_metrics_file)
 from .perfetto import TraceBuilder
 from .profiler import annotate, profile_session
+from .query import (add_flight_flows, chain_lines, explain_delivery,
+                    find_deliveries)
 from .telemetry import (TELEMETRY_MODES, TelemetryFrames, TelemetryRow,
                         decode_frames, summarize_frames, validate_mode)
 
@@ -50,4 +72,11 @@ __all__ = [
     "METRICS_SCHEMA", "MetricsRegistry", "validate_line",
     "validate_metrics_file",
     "TraceBuilder", "profile_session", "annotate",
+    "RECORD_MODES", "RecordRow", "FlightLog", "FlightWriter",
+    "FlightRecorderMixin", "validate_record", "decode_flight",
+    "concat_flight", "load_flight_jsonl",
+    "explain_delivery", "find_deliveries", "chain_lines",
+    "add_flight_flows",
+    "DivergenceReport", "bisect_engines", "chain_bisect",
+    "first_trail_divergence",
 ]

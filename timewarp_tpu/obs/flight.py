@@ -1,0 +1,606 @@
+"""The causal flight recorder: per-message provenance on-device.
+
+A :class:`RecordRow` is the fixed-shape, bounded per-superstep event
+plane an engine threads through its traced scan when ``record !=
+"off"`` — the third rider on the ``StepOut`` vehicle after telemetry
+(``telem``) and integrity (``integ``), under the same hard contract:
+**zero overhead when off, bit-exact when on**. ``None`` when off, so
+the off-mode jaxpr is byte-identical to the pre-knob engine; every
+recorded value is derived only from values the superstep already
+computes (the deliver mask, the routed batch, the fault masks), so
+states, traces, digests, and checkpoints are bit-identical in every
+mode (tests/test_zzzzzflight.py).
+
+Modes:
+
+- ``"deliveries"`` — one event per delivered message: ``(src, dst,
+  deliver_t)`` (``send_t`` is unknown at delivery and recorded -1;
+  ``full`` mode's send events carry it, and the causal-query layer
+  joins the two on ``(src, dst, deliver_t)``).
+- ``"full"`` — adds send events ``(src, dst, send_t, deliver_t)``
+  and fault-action events: ``defer`` (a crash window slid a node's
+  pending event to ``t_up``), ``cut`` (a partition killed a send),
+  ``down`` (a delivery landed inside the destination's down window),
+  ``purge`` (a reset restart dropped pre-crash mailbox entries),
+  ``restart`` (the injected reboot firing itself).
+
+The plane is a bounded ring: ``record_cap`` events per superstep
+(default 256). Events beyond capacity are dropped while ``n_ev``
+keeps counting — ``n_ev`` exceeding the stored count IS the overflow
+evidence, never silent (the same contract as the engines' device
+event ring). Within a superstep the event order is pinned:
+deliveries (node-major, slot order), then the fault/send captures in
+superstep order (defer, restart, purge, cut, sends) — deterministic
+per engine, so a recorded log is replayable evidence.
+
+Host side: :func:`decode_flight` turns the scan's stacked rows into a
+:class:`FlightLog` (per world, batched), and :class:`FlightWriter`
+drains logs per chunk into a schema'd JSONL event log —
+METRICS_SCHEMA v4 ``event`` lines with ``name="flight"``, validated
+by ``python -m timewarp_tpu.obs.metrics validate`` like every other
+metrics stream (obs/metrics.py).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from dataclasses import dataclass
+from typing import Any, List, NamedTuple, Optional
+
+import numpy as np
+
+__all__ = ["RECORD_MODES", "RecordRow", "FlightLog", "FlightWriter",
+           "FlightRecorderMixin", "validate_record", "empty_row",
+           "record_masked", "record_compacted", "compact",
+           "record_deliveries",
+           "decode_flight", "concat_flight", "load_flight_jsonl",
+           "EV_DELIVER", "EV_SEND", "EV_FAULT", "TAG_DEFER",
+           "TAG_CUT", "TAG_DOWN", "TAG_PURGE", "TAG_RESTART",
+           "KIND_NAMES", "ACTION_NAMES"]
+
+#: the engine knob's legal values, in increasing cost order
+RECORD_MODES = ("off", "deliveries", "full")
+
+#: event kinds (RecordRow.kind; 0 = empty slot)
+EV_DELIVER, EV_SEND, EV_FAULT = 1, 2, 3
+KIND_NAMES = {EV_DELIVER: "deliver", EV_SEND: "send", EV_FAULT: "fault"}
+
+#: fault-action tags (RecordRow.tag for EV_FAULT events; a SEND whose
+#: delivery lands in the destination's down window is recorded as an
+#: EV_FAULT with TAG_DOWN — the send's fate rides its tag)
+TAG_DEFER, TAG_CUT, TAG_DOWN, TAG_PURGE, TAG_RESTART = 1, 2, 3, 4, 5
+ACTION_NAMES = {TAG_DEFER: "defer", TAG_CUT: "cut", TAG_DOWN: "down",
+                TAG_PURGE: "purge", TAG_RESTART: "restart"}
+
+
+def validate_record(mode: str, who: str = "engine") -> str:
+    """Loud knob validation — a typo'd mode must not silently run
+    unrecorded (mirrors obs.telemetry.validate_mode)."""
+    if mode not in RECORD_MODES:
+        raise ValueError(
+            f"{who}: record must be one of {RECORD_MODES}, got "
+            f"{mode!r} ('off' = zero overhead, 'deliveries' = one "
+            "event per delivered message, 'full' = + sends and fault "
+            "actions — docs/observability.md)")
+    return mode
+
+
+class RecordRow(NamedTuple):
+    """One superstep's bounded event plane (device arrays; [B, ...]
+    per world under the batch vmap). ``n_ev`` counts every event the
+    superstep produced — past ``cap`` they are dropped but still
+    counted (the overflow evidence). Empty slots carry kind 0.
+
+    The deliveries-mode row is SLIM: ``kind``/``send_t``/``tag`` are
+    ``None`` (a single capture fills slots ``[0, min(n_ev, cap))``
+    contiguously, every event is an EV_DELIVER with unknown send
+    instant, so the three constant planes carry zero information —
+    dropping them removes their per-superstep scan-output traffic,
+    the dominant deliveries-mode cost at smoke scale; decode
+    reconstructs them host-side)."""
+    n_ev: Any     # int32[] — events produced (stored + dropped)
+    kind: Any     # int32[R] — EV_* (0 = empty slot); None when slim
+    src: Any      # int32[R]
+    dst: Any      # int32[R]
+    send_t: Any   # int64[R] -- send instant (-1 = unknown); None slim
+    t: Any        # int64[R] — deliver / action instant
+    tag: Any      # int32[R] — TAG_* for EV_FAULT rows; None when slim
+
+
+# ---------------------------------------------------------------------------
+# device-side builders (called inside the engines' traced superstep)
+# ---------------------------------------------------------------------------
+
+def empty_row(cap: int) -> RecordRow:
+    import jax.numpy as jnp
+    z32 = jnp.zeros((cap,), jnp.int32)
+    z64 = jnp.zeros((cap,), jnp.int64)
+    return RecordRow(n_ev=jnp.int32(0), kind=z32, src=z32, dst=z32,
+                     send_t=z64, t=z64, tag=z32)
+
+
+def _flat(v, shape, dtype):
+    import jax.numpy as jnp
+    return jnp.broadcast_to(jnp.asarray(v, dtype), shape).reshape(-1)
+
+
+def record_masked(row: RecordRow, kind, mask, src, dst, send_t, t,
+                  tag=0, t_off=None) -> RecordRow:
+    """Append the masked events to ``row``: an inclusive cumsum over
+    the mask counts live elements (flat order preserved — the pinned
+    within-superstep order), each buffer lane binary-searches the
+    cumsum for ITS live element (``searchsorted``: lane ``rel`` holds
+    the first flat index whose running count reaches ``rel + 1``),
+    and each column is then a bounded GATHER at offset ``n_ev``.
+    Gathers, not scatters or sorts, deliberately — this compaction is
+    the recorder's whole device cost, and the measured ladder on
+    XLA:CPU is searchsorted ≈ 2× cheaper than an iota scatter ≈ 2.5×
+    cheaper than a stable argsort (an XLA:CPU scatter of the column
+    values themselves additionally re-materializes its producers per
+    element; gossip_100k_record's overhead budget pins the choice).
+    Capacity drops are counted in ``n_ev``, never silent.
+    ``src``/``dst``/``send_t``/``t``/``tag``/``kind`` broadcast
+    against ``mask``'s shape — a scalar column skips the gather
+    entirely, and ``t_off`` (a scalar added to the gathered ``t``)
+    lets callers pass the engines' int32 *relative* deliver plane
+    instead of materializing a mask-wide int64 absolute one."""
+    import jax.numpy as jnp
+    cap = row.kind.shape[0]
+    shape = mask.shape
+    m = mask.reshape(-1)
+    M = m.size
+    cs = jnp.cumsum(m.astype(jnp.int32))
+    n_new = cs[-1]
+    lane = jnp.arange(cap, dtype=jnp.int32)
+    rel = lane - row.n_ev             # slot in the compacted view
+    pick = (rel >= 0) & (rel < n_new)
+    idx = jnp.clip(jnp.searchsorted(cs, rel + 1, side="left"),
+                   0, M - 1)
+
+    def put(buf, v, dtype, off=None):
+        if off is None and (np.isscalar(v)
+                            or getattr(v, "ndim", 1) == 0):
+            return jnp.where(pick, jnp.asarray(v, dtype), buf)
+        if off is None:
+            g = _flat(v, shape, dtype)[idx]
+        else:
+            # gather the narrow plane, widen + offset at buffer width
+            g = off + jnp.broadcast_to(
+                v, shape).reshape(-1)[idx].astype(dtype)
+        return jnp.where(pick, g, buf)
+    return RecordRow(
+        n_ev=row.n_ev + n_new,
+        kind=put(row.kind, kind, jnp.int32),
+        src=put(row.src, src, jnp.int32),
+        dst=put(row.dst, dst, jnp.int32),
+        send_t=put(row.send_t, send_t, jnp.int64),
+        t=put(row.t, t, jnp.int64, t_off),
+        tag=put(row.tag, tag, jnp.int32),
+    )
+
+
+def record_deliveries(cap: int, mask, src, dst, t,
+                      t_off=None) -> RecordRow:
+    """The deliveries-mode fast path: one slim row straight from the
+    deliver mask — the same cumsum + ``searchsorted`` compaction as
+    :func:`record_masked`, but starting from an empty buffer (so
+    ``pick`` is just ``lane < n_new``) and carrying ``None`` for the
+    three constant planes (see :class:`RecordRow`)."""
+    import jax.numpy as jnp
+    shape = mask.shape
+    m = mask.reshape(-1)
+    M = m.size
+    cs = jnp.cumsum(m.astype(jnp.int32))
+    n_new = cs[-1]
+    lane = jnp.arange(cap, dtype=jnp.int32)
+    pick = lane < n_new
+    idx = jnp.clip(jnp.searchsorted(cs, lane + 1, side="left"),
+                   0, M - 1)
+
+    def put(v, dtype, off=None):
+        if off is None and (np.isscalar(v)
+                            or getattr(v, "ndim", 1) == 0):
+            g = jnp.asarray(v, dtype)
+        elif off is None:
+            g = _flat(v, shape, dtype)[idx]
+        else:
+            g = off + jnp.broadcast_to(
+                v, shape).reshape(-1)[idx].astype(dtype)
+        return jnp.where(pick, g, jnp.zeros((cap,), dtype))
+    return RecordRow(
+        n_ev=n_new, kind=None,
+        src=put(src, jnp.int32), dst=put(dst, jnp.int32),
+        send_t=None, t=put(t, jnp.int64, t_off), tag=None)
+
+
+def compact(cap: int, kind, mask, src, dst, send_t, t,
+            tag=0, t_off=None) -> RecordRow:
+    """Compact one masked event source into a standalone fixed-shape
+    [cap] buffer — what the routing regimes return through their
+    ``lax.switch`` branches (a side-channel set inside a branch would
+    be an escaped tracer; a fixed-shape return value rides the switch
+    legally). Merge with :func:`record_compacted`."""
+    return record_masked(empty_row(cap), kind, mask, src, dst,
+                         send_t, t, tag, t_off=t_off)
+
+
+def record_compacted(row: RecordRow, comp: RecordRow) -> RecordRow:
+    """Append a pre-compacted buffer (:func:`compact`) onto ``row`` —
+    a pure bounded gather at offset ``n_ev`` (no scatter; see
+    :func:`record_masked`). ``comp.n_ev`` carries events ``comp``
+    itself dropped at capacity; they stay counted (and would not have
+    fit ``row`` either — the two caps are the same)."""
+    import jax.numpy as jnp
+    cap = row.kind.shape[0]
+    lane = jnp.arange(cap, dtype=jnp.int32)
+    rel = lane - row.n_ev                 # slot in comp's buffer
+    pick = (rel >= 0) & (rel < jnp.minimum(comp.n_ev, jnp.int32(cap)))
+    idx = jnp.clip(rel, 0, cap - 1)
+
+    def put(buf, v):
+        return jnp.where(pick, v[idx], buf)
+    return RecordRow(
+        n_ev=row.n_ev + comp.n_ev,
+        kind=put(row.kind, comp.kind), src=put(row.src, comp.src),
+        dst=put(row.dst, comp.dst),
+        send_t=put(row.send_t, comp.send_t), t=put(row.t, comp.t),
+        tag=put(row.tag, comp.tag),
+    )
+
+
+# ---------------------------------------------------------------------------
+# host-side decode
+# ---------------------------------------------------------------------------
+
+_COLS = ("superstep", "t_sup", "kind", "src", "dst", "send_t", "t",
+         "tag")
+
+
+@dataclass
+class FlightLog:
+    """Host-side decode of one run's recorded events: one row per
+    stored event, with the (run-global) superstep index and the
+    superstep instant attached. ``dropped`` counts events past the
+    per-superstep capacity (``n_ev`` overflow) — a complete log has
+    ``dropped == 0``."""
+    superstep: np.ndarray   # int64[M]
+    t_sup: np.ndarray       # int64[M] — the superstep's instant
+    kind: np.ndarray        # int32[M] — EV_*
+    src: np.ndarray         # int32[M]
+    dst: np.ndarray         # int32[M]
+    send_t: np.ndarray      # int64[M] (-1 = unknown)
+    t: np.ndarray           # int64[M]
+    tag: np.ndarray         # int32[M]
+    dropped: int = 0
+
+    def __len__(self) -> int:
+        return len(self.kind)
+
+    def event(self, i: int) -> dict:
+        """One event as the schema'd record body (the JSONL line's
+        payload fields — FlightWriter adds the envelope)."""
+        k = int(self.kind[i])
+        rec = {"ev": KIND_NAMES.get(k, str(k)),
+               "superstep": int(self.superstep[i]),
+               "t_sup_us": int(self.t_sup[i]),
+               "src": int(self.src[i]), "dst": int(self.dst[i]),
+               "send_t_us": int(self.send_t[i]),
+               "t_us": int(self.t[i]), "tag": int(self.tag[i])}
+        if k == EV_FAULT:
+            rec["action"] = ACTION_NAMES.get(int(self.tag[i]),
+                                             str(int(self.tag[i])))
+        return rec
+
+    def keyset(self):
+        """The event identity tuples — what the bisection's event
+        delta diffs (superstep index deliberately excluded: two runs
+        may chunk differently yet carry the same events)."""
+        return {(int(self.kind[i]), int(self.src[i]),
+                 int(self.dst[i]), int(self.send_t[i]),
+                 int(self.t[i]), int(self.tag[i]))
+                for i in range(len(self))}
+
+
+def _empty_log() -> FlightLog:
+    return FlightLog(*(np.zeros(0, np.int64) if c in
+                       ("superstep", "t_sup", "send_t", "t")
+                       else np.zeros(0, np.int32) for c in _COLS))
+
+
+def decode_flight(rec, valid, t_us, offset=0,
+                  n_worlds: Optional[int] = None):
+    """Decode the scan's stacked record rows ([T, R] leaves; [T, B, R]
+    batched) into a :class:`FlightLog` (solo) or one per world,
+    masked to the supersteps that actually fired. ``offset`` (the
+    engine state's superstep count at chunk entry; [B] batched) makes
+    the indices run-global, so chunked drivers concatenate without
+    bookkeeping."""
+    valid = np.asarray(valid)
+    t_us = np.asarray(t_us)
+    offset = np.asarray(offset, np.int64)
+
+    def one(world: Optional[int]) -> FlightLog:
+        m = valid if world is None else valid[:, world]
+
+        def col(x):
+            a = np.asarray(x)
+            return a[m] if world is None else a[m, world]
+        n_ev = col(rec.n_ev).astype(np.int64)            # [S]
+        src = col(rec.src)                               # [S, R]
+        ts = col(t_us)
+        S, R = src.shape
+        if rec.kind is None:
+            # slim deliveries-mode row (RecordRow docstring): the
+            # live slots are exactly [0, min(n_ev, R)), every event
+            # is an EV_DELIVER with unknown send instant
+            lanes = np.arange(R, dtype=np.int64)
+            live = lanes[None, :] < np.minimum(n_ev, R)[:, None]
+            kind = np.where(live, np.int32(EV_DELIVER),
+                            np.int32(0))
+            send_t = np.full((S, R), -1, np.int64)
+            tag = np.zeros((S, R), np.int32)
+        else:
+            kind = col(rec.kind)
+            send_t = np.asarray(col(rec.send_t), np.int64)
+            tag = col(rec.tag)
+        off = int(offset if world is None else offset[world])
+        sel = kind.reshape(-1) > 0
+        sup = np.repeat(np.arange(S, dtype=np.int64) + off, R)[sel]
+        tsup = np.repeat(ts, R)[sel]
+        stored = (kind > 0).sum()
+        return FlightLog(
+            superstep=sup, t_sup=tsup.astype(np.int64),
+            kind=kind.reshape(-1)[sel],
+            src=src.reshape(-1)[sel],
+            dst=col(rec.dst).reshape(-1)[sel],
+            send_t=send_t.reshape(-1)[sel],
+            t=col(rec.t).reshape(-1)[sel].astype(np.int64),
+            tag=tag.reshape(-1)[sel],
+            dropped=int(np.maximum(n_ev.sum() - stored, 0)))
+
+    if n_worlds is None:
+        return one(None)
+    return [one(b) for b in range(n_worlds)]
+
+
+def concat_flight(chunks):
+    """Concatenate per-chunk :class:`FlightLog`\\ s (or per-world
+    lists of them) into one run-level log — superstep indices are
+    already run-global (decode's ``offset``), so this is a plain
+    column concat."""
+    chunks = [c for c in chunks if c is not None]
+    if not chunks:
+        return None
+    if isinstance(chunks[0], list):
+        B = len(chunks[0])
+        return [concat_flight([c[b] for c in chunks])
+                for b in range(B)]
+    return FlightLog(
+        *(np.concatenate([getattr(c, col) for c in chunks])
+          for col in _COLS),
+        dropped=sum(c.dropped for c in chunks))
+
+
+# ---------------------------------------------------------------------------
+# the JSONL event log (METRICS_SCHEMA `event` kind, name="flight")
+# ---------------------------------------------------------------------------
+
+class FlightWriter:
+    """Append-only schema'd JSONL event log. Every line is a
+    METRICS_SCHEMA ``event`` record with ``name="flight"`` — the
+    stream re-validates with ``python -m timewarp_tpu.obs.metrics
+    validate`` (a malformed line refuses to be written at all). Safe
+    for concurrent buckets: appends serialize under one lock.
+    ``events`` counts recorded events (drop-marker lines excluded —
+    the count agrees with per-world ``len(FlightLog)`` everywhere).
+    ``truncate=True`` starts the file fresh — the solo CLI uses it so
+    re-running a command does not silently merge two runs' events
+    into one un-disambiguatable log (solo lines carry no ``run_id``,
+    so :func:`load_flight_jsonl`'s multi-run refusal could not catch
+    the merge); the sweep service keeps appending, its lines are
+    ``run_id``-stamped."""
+
+    def __init__(self, path: str, run: Optional[str] = None,
+                 truncate: bool = False) -> None:
+        self.path = path
+        self.run = run
+        self.events = 0
+        self._fh = None
+        self._mode = "w" if truncate else "a"
+        self._lock = threading.Lock()
+
+    def write(self, log: FlightLog, world: Optional[int] = None,
+              run_id: Optional[str] = None) -> int:
+        from .metrics import METRICS_SCHEMA, validate_line
+
+        def envelope(rec):
+            if self.run is not None:
+                rec["run"] = self.run
+            if world is not None:
+                rec["world"] = int(world)
+            if run_id is not None:
+                rec["run_id"] = run_id
+            validate_line(rec)
+            return json.dumps(rec, sort_keys=True)
+        lines = []
+        for i in range(len(log)):
+            lines.append(envelope(
+                {"schema": METRICS_SCHEMA, "kind": "event",
+                 "name": "flight", **log.event(i)}))
+        if log.dropped:
+            # the overflow evidence must cross the file boundary too:
+            # without this line a reloaded log would look complete
+            # (load_flight_jsonl sums these back into
+            # FlightLog.dropped)
+            lines.append(envelope(
+                {"schema": METRICS_SCHEMA, "kind": "event",
+                 "name": "flight_drops", "dropped": int(log.dropped)}))
+        with self._lock:
+            if self._fh is None:
+                self._fh = open(self.path, self._mode)
+                self._mode = "a"          # one truncation per writer
+            for ln in lines:
+                self._fh.write(ln + "\n")
+            self._fh.flush()
+            self.events += len(log)
+        return len(log)
+
+    def close(self) -> None:
+        with self._lock:
+            if self._fh is not None:
+                self._fh.close()
+                self._fh = None
+
+
+def load_flight_jsonl(path: str, run_id: Optional[str] = None,
+                      world: Optional[int] = None) -> FlightLog:
+    """Load a :class:`FlightWriter` event log back into a
+    :class:`FlightLog` (the ``explain`` CLI's input). Non-flight
+    metrics lines in the same file are skipped; ``run_id``/``world``
+    filter a sweep's shared log down to one world. A log that still
+    spans several runs or worlds after the given filters REFUSES to
+    load — one merged FlightLog would let the causal join pair a send
+    from one run with a delivery from another, a confidently wrong
+    chain (the module's loud-failure convention)."""
+    names = {v: k for k, v in KIND_NAMES.items()}
+    cols: dict = {c: [] for c in _COLS}
+    seen_runs: set = set()
+    seen_worlds: set = set()
+    n = dropped = 0
+    with open(path) as f:
+        for line in f:
+            if not line.strip():
+                continue
+            rec = json.loads(line)
+            if rec.get("kind") != "event" \
+                    or rec.get("name") not in ("flight",
+                                               "flight_drops"):
+                continue
+            if run_id is not None and rec.get("run_id") != run_id:
+                continue
+            if world is not None and rec.get("world") != world:
+                continue
+            seen_runs.add(rec.get("run_id"))
+            seen_worlds.add(rec.get("world"))
+            if rec["name"] == "flight_drops":
+                # the writer's overflow evidence (FlightWriter.write)
+                dropped += int(rec.get("dropped", 0))
+                continue
+            n += 1
+            cols["superstep"].append(rec["superstep"])
+            cols["t_sup"].append(rec.get("t_sup_us", -1))
+            cols["kind"].append(names.get(rec["ev"], 0))
+            cols["src"].append(rec["src"])
+            cols["dst"].append(rec["dst"])
+            cols["send_t"].append(rec.get("send_t_us", -1))
+            cols["t"].append(rec["t_us"])
+            cols["tag"].append(rec.get("tag", 0))
+    if n == 0:
+        raise ValueError(
+            f"{path!r} holds no flight events"
+            + (f" for run_id {run_id!r}" if run_id is not None else "")
+            + (f" world {world}" if world is not None else "")
+            + " — record one with --record deliveries|full "
+            "--record-out FILE (docs/observability.md)")
+    if run_id is None and len(seen_runs) > 1:
+        raise ValueError(
+            f"{path!r} holds flight events from "
+            f"{len(seen_runs)} runs ({sorted(map(str, seen_runs))}) "
+            "— pick one with run_id=/--run-id; a merged log would "
+            "join causal chains across unrelated runs")
+    if world is None and len(seen_worlds) > 1:
+        raise ValueError(
+            f"{path!r} holds flight events from "
+            f"{len(seen_worlds)} worlds "
+            f"({sorted(map(str, seen_worlds))}) — pick one with "
+            "world=/--world; a merged log would join causal chains "
+            "across unrelated worlds")
+    return FlightLog(
+        *(np.asarray(cols[c],
+                     np.int64 if c in ("superstep", "t_sup",
+                                       "send_t", "t")
+                     else np.int32) for c in _COLS),
+        dropped=dropped)
+
+
+# ---------------------------------------------------------------------------
+# engine wiring
+# ---------------------------------------------------------------------------
+
+class FlightRecorderMixin:
+    """``record=`` wiring + the host-side drain every scan-driver
+    engine shares. Host state only: an engine with ``record="off"``
+    lowers byte-identical jaxprs to the pre-knob engine (the event
+    plane is a ``None`` StepOut field, exactly like telemetry)."""
+
+    #: the engine's record mode ("off" | "deliveries" | "full")
+    record = "off"
+    #: per-superstep event capacity (overflow counted, never silent)
+    record_cap = 256
+    #: optional FlightWriter the traced drivers drain each chunk
+    flight_out = None
+    #: the last traced run's FlightLog (list per world, batched)
+    last_run_flight = None
+
+    def _bind_record(self, record: str,
+                     record_cap: Optional[int]) -> None:
+        self.record = validate_record(record, type(self).__name__)
+        if record_cap is not None:
+            if record_cap < 1:
+                raise ValueError(
+                    f"record_cap must be >= 1, got {record_cap}")
+            self.record_cap = int(record_cap)
+
+    def _rec_cut(self, rec_full: bool, cutm, src, dst, tmsg) -> None:
+        """Flight-recorder capture of partition-cut sends (full mode)
+        — called where each routing regime computes its cut mask, with
+        the PRE-cut destination values (``cutm``'s positions still
+        carry them). Appends onto the engine's per-trace
+        ``_rec_extra`` side channel (merged into the StepOut event
+        plane by the superstep's tail)."""
+        if not rec_full:
+            return
+        self._rec_extra.append(compact(
+            self.record_cap, EV_FAULT, cutm, src, dst, tmsg, tmsg,
+            TAG_CUT))
+
+    def _rec_sends(self, ok, downm, src, dst, tmsg, dt_abs):
+        """Compacted send-event buffer (full mode): kind SEND, except
+        a send whose delivery lands inside the destination's down
+        window is re-recorded as EV_FAULT with TAG_DOWN — the send's
+        fate rides its tag. Returns the fixed-shape buffer rather
+        than appending it, because JaxEngine's adaptive ladder calls
+        this inside ``lax.switch`` branches, which must return it (a
+        ``self`` side channel set inside a branch would be an escaped
+        tracer); non-branch callers append the return themselves."""
+        import jax.numpy as jnp
+        if downm is None:
+            kind, tag = EV_SEND, 0
+        else:
+            kind = jnp.where(downm, jnp.int32(EV_FAULT),
+                             jnp.int32(EV_SEND))
+            tag = jnp.where(downm, jnp.int32(TAG_DOWN), jnp.int32(0))
+        return compact(self.record_cap, kind, ok, src, dst, tmsg,
+                       dt_abs, tag)
+
+    def _capture_flight(self, ys, state_before) -> None:
+        """Host-side decode of one traced run's record plane onto
+        ``last_run_flight`` (+ a chunk drain to an attached
+        FlightWriter) — a no-op in off mode."""
+        import jax
+        self.last_run_flight = None
+        if self.record == "off" or ys is None \
+                or getattr(ys, "rec", None) is None:
+            return
+        batch = getattr(self, "batch", None)
+        off = np.asarray(jax.device_get(state_before.steps), np.int64)
+        self.last_run_flight = decode_flight(
+            ys.rec, np.asarray(ys.valid), np.asarray(ys.t),
+            offset=off, n_worlds=None if batch is None else batch.B)
+        if self.flight_out is not None:
+            if isinstance(self.last_run_flight, list):
+                for b, lg in enumerate(self.last_run_flight):
+                    self.flight_out.write(lg, world=b)
+            else:
+                self.flight_out.write(self.last_run_flight)

@@ -49,9 +49,11 @@ __all__ = ["METRICS_SCHEMA", "MetricsRegistry", "validate_line",
 
 #: bump when a kind's required fields change shape (or the kind
 #: inventory grows: v2 added the dispatch-controller `decision`
-#: kind, v3 the state-integrity `integrity` kind — a v1 reader would
-#: mis-skip lines it cannot interpret)
-METRICS_SCHEMA = 3
+#: kind, v3 the state-integrity `integrity` kind, v4 the flight-
+#: recorder event form — `event` lines with name="flight" carry the
+#: per-message provenance fields below — a v1 reader would mis-skip
+#: lines it cannot interpret)
+METRICS_SCHEMA = 4
 
 _NUM = (int, float)
 #: kind -> {required field: type tuple}; extra fields are allowed
@@ -81,6 +83,14 @@ _KINDS: Dict[str, Dict[str, tuple]] = {
     "event": {"name": (str,)},
 }
 
+#: extra required fields of the flight-recorder event form (v4,
+#: obs/flight.py): an `event` line with name="flight" is one recorded
+#: message/fault event and must carry the full provenance tuple
+_FLIGHT_FIELDS: Dict[str, tuple] = {
+    "ev": (str,), "superstep": (int,), "src": (int,), "dst": (int,),
+    "send_t_us": (int,), "t_us": (int,),
+}
+
 
 def validate_line(rec: Any) -> None:
     """Validate one metrics record against the schema; raises
@@ -108,6 +118,17 @@ def validate_line(rec: Any) -> None:
             raise ValueError(
                 f"metrics kind {kind!r}: field {field!r} must be "
                 f"{'/'.join(t.__name__ for t in types)}, got {v!r}")
+    if kind == "event" and rec.get("name") == "flight":
+        # the flight-recorder event form (v4): name="flight" promises
+        # the per-message provenance tuple — a half-written event is
+        # worse than none (the causal-query layer would join garbage)
+        for field, types in _FLIGHT_FIELDS.items():
+            v = rec.get(field)
+            if isinstance(v, bool) or not isinstance(v, types):
+                raise ValueError(
+                    f"flight event: field {field!r} must be "
+                    f"{'/'.join(t.__name__ for t in types)}, got "
+                    f"{v!r} (obs/flight.py)")
 
 
 def validate_metrics_file(path: str) -> int:
@@ -129,6 +150,15 @@ def validate_metrics_file(path: str) -> int:
             except ValueError as e:
                 raise ValueError(f"{path}:{i}: {e}") from None
             n += 1
+    if n == 0:
+        # an empty stream validating "OK" would let a CI gate pass on
+        # a run that never recorded anything — fail actionably,
+        # naming the file
+        raise ValueError(
+            f"{path}: contains no metrics records (empty or "
+            "whitespace-only file) — the producing run wrote "
+            "nothing; check its --telemetry/--record/--metrics-out "
+            "flags (docs/observability.md)")
     return n
 
 
@@ -223,7 +253,12 @@ def _main(argv) -> int:
     if len(argv) != 2 or argv[0] != "validate":
         raise SystemExit(
             "usage: python -m timewarp_tpu.obs.metrics validate FILE")
-    n = validate_metrics_file(argv[1])
+    try:
+        n = validate_metrics_file(argv[1])
+    except (OSError, ValueError) as e:
+        # the CLI convention everywhere else (test_zgrammar): exit 1
+        # with the actionable message, never a raw traceback
+        raise SystemExit(str(e))
     print(json.dumps({"file": argv[1], "lines": n, "ok": True}))
     return 0
 

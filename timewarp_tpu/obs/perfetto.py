@@ -94,12 +94,45 @@ class TraceBuilder:
             "pid": PID_VIRTUAL,
             "args": {k: float(v) for k, v in values.items()}})
 
+    def flow_arrow(self, name: str, src_tid: int, src_ts_us,
+                   dst_tid: int, dst_ts_us, flow_id: int,
+                   cat: str = "flow",
+                   args: Optional[dict] = None) -> None:
+        """One causal arrow on the virtual-time timeline: a flow
+        ('s' -> 'f') pair between two node tracks, each end anchored
+        to a thin slice (Perfetto binds flow events to enclosing
+        slices, so the anchors are part of the arrow). The flight
+        recorder's causal queries (obs/query.py) emit send->deliver
+        arrows this way — message journeys become visible lines
+        across the node tracks."""
+        src_ts, dst_ts = float(src_ts_us), float(dst_ts_us)
+        for tid, ts in ((src_tid, src_ts), (dst_tid, dst_ts)):
+            ev = {"name": name, "cat": cat, "ph": "X",
+                  "ts": round(ts, 3), "dur": 1.0,
+                  "pid": PID_VIRTUAL, "tid": int(tid)}
+            if args:
+                ev["args"] = args
+            self.events.append(ev)
+        self.events.append({"name": name, "cat": cat, "ph": "s",
+                            "id": int(flow_id),
+                            "ts": round(src_ts + 0.5, 3),
+                            "pid": PID_VIRTUAL, "tid": int(src_tid)})
+        self.events.append({"name": name, "cat": cat, "ph": "f",
+                            "bp": "e", "id": int(flow_id),
+                            "ts": round(dst_ts + 0.5, 3),
+                            "pid": PID_VIRTUAL, "tid": int(dst_tid)})
+
     def add_superstep_track(self, frames, trace=None,
                             world: Optional[int] = None) -> None:
         """Counter series over one run's supersteps: the telemetry
         frames (obs/telemetry.py), plus fired/delivered densities when
         the SuperstepTrace is given. ``world`` suffixes the series
-        names so fleet worlds get separate tracks."""
+        names so fleet worlds get separate tracks. Zero-superstep
+        inputs (an empty run, a world that never fired) add nothing —
+        the empty-trace guard in :meth:`save` keeps the file valid."""
+        if frames is None or (len(frames) == 0
+                              and (trace is None or len(trace) == 0)):
+            return
         sfx = "" if world is None else f" [w{world}]"
         for i in range(len(frames)):
             ts = int(frames.t_us[i])
@@ -127,10 +160,22 @@ class TraceBuilder:
     # -- output ------------------------------------------------------------
 
     def to_json(self) -> dict:
-        return {"traceEvents": self.events, "displayTimeUnit": "ms"}
+        events = self.events
+        if not any(e.get("ph") != "M" for e in events):
+            # empty-run guard: a trace holding ONLY metadata records
+            # renders as a blank (or rejected) file in Perfetto —
+            # an explicit marker keeps the artifact valid and says
+            # WHY it is empty instead of looking corrupt
+            events = events + [{
+                "name": "empty run (no supersteps recorded)",
+                "cat": "host", "ph": "i", "ts": 0.0, "s": "p",
+                "pid": PID_HOST, "tid": 1}]
+        return {"traceEvents": events, "displayTimeUnit": "ms"}
 
     def save(self, path: str) -> str:
-        """Write the trace; the file opens directly in Perfetto."""
+        """Write the trace; the file opens directly in Perfetto (the
+        empty-run guard in :meth:`to_json` keeps even a zero-superstep
+        run's file valid)."""
         with open(path, "w") as f:
             json.dump(self.to_json(), f)
         return path

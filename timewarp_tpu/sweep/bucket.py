@@ -117,7 +117,8 @@ def plan_buckets(configs, max_bucket: int = 64) -> List[Bucket]:
 
 def build_bucket_engine(bucket: Bucket, *, lint: str = "warn",
                         telemetry: str = "off", controller=None,
-                        verify: str = "off"):
+                        verify: str = "off", record: str = "off",
+                        record_cap=None):
     """One batched :class:`~timewarp_tpu.interp.jax_engine.engine.
     JaxEngine` serving every world of the bucket. World b's seed,
     sweepable link values, and (padded) fault schedule are exactly
@@ -159,8 +160,11 @@ def build_bucket_engine(bucket: Bucket, *, lint: str = "warn",
     # verify is bit-exact like telemetry (the guard plane feeds
     # nothing back), so streamed results stay mode-independent and
     # the sweep survival law's solo twin needs no knob of its own
+    # record is bit-exact like telemetry/verify (the event plane
+    # feeds nothing back), so streamed results stay mode-independent
     eng = JaxEngine(sc, links[0], window=bucket.window, batch=spec,
                     faults=fleet, lint=lint, telemetry=telemetry,
-                    controller=controller, verify=verify)
+                    controller=controller, verify=verify,
+                    record=record, record_cap=record_cap)
     eng.metrics_label = f"bucket:{bucket.bucket_id}"
     return eng

@@ -36,11 +36,13 @@ __all__ = ["sweep_main"]
 
 
 def _loud(fn):
-    """Library config errors (SweepConfigError) become clean CLI
-    exits, keeping the grammar-named message without a traceback."""
+    """Library config errors (SweepConfigError, and the service's
+    construction-time ValueError guards — bad chunk/retries, an
+    unarmed flip injection) become clean CLI exits, keeping the
+    guard-named message without a traceback."""
     try:
         return fn()
-    except SweepConfigError as e:
+    except (SweepConfigError, ValueError) as e:
         raise SystemExit(str(e)) from None
 
 
@@ -96,6 +98,18 @@ def _service_args(p: argparse.ArgumentParser) -> None:
     p.add_argument("--trace-out", default=None,
                    help="write the Perfetto trace here instead of "
                         "<journal>/trace.json (needs --telemetry)")
+    p.add_argument("--record", default="off",
+                   choices=["off", "deliveries", "full"],
+                   help="causal flight recorder per bucket "
+                        "(obs/flight.py, docs/observability.md): "
+                        "bucket engines thread the bounded event "
+                        "plane (bit-exact; results are mode-"
+                        "independent) and every chunk's per-world "
+                        "events drain into <journal>/events.jsonl "
+                        "tagged by run_id — query with `timewarp-tpu "
+                        "explain <journal>/events.jsonl --run-id ID`; "
+                        "`sweep status` surfaces per-world event "
+                        "counts")
 
 
 def _kw(args) -> dict:
@@ -108,7 +122,24 @@ def _kw(args) -> dict:
                 grace_us=args.grace_us, max_bucket=args.max_bucket,
                 lint=args.lint, inject=args.inject,
                 telemetry=args.telemetry, trace_out=args.trace_out,
-                verify=args.state_verify)
+                verify=args.state_verify, record=args.record,
+                # a promised post-sweep --verify arms the flip guard's
+                # other legal detection path (service.py)
+                post_verify=args.verify)
+
+
+def _auto_bisect(trail, trace) -> dict:
+    """Localize one ``--verify`` mismatch (obs/bisect.py): fold the
+    solo twin's trace rows (already computed by the verify run
+    itself) chunk-for-chunk against the world's journaled digest
+    trail (the ``world_done`` record's ``chain``) — the result names
+    the first diverging chunk and its superstep span, or reports that
+    every journaled chunk agrees (the divergence then lies outside
+    the digested rows)."""
+    if not trail:
+        return {"first_divergence": None}
+    from ..obs.bisect import first_trail_divergence
+    return {"first_divergence": first_trail_divergence(trail, trace)}
 
 
 def _finish(svc: SweepService, verify: bool) -> int:
@@ -121,10 +152,12 @@ def _finish(svc: SweepService, verify: bool) -> int:
     if svc.trace_path is not None:
         out["trace"] = svc.trace_path
         out["metrics"] = svc.metrics.path
+    if svc.flight is not None:
+        out["events"] = svc.flight.path
+        out["flight_events"] = svc.flight.events
     if verify:
         mismatches = []
-        scan = svc.journal.scan() if any(
-            c.controller == "auto" for c in svc.pack.configs) else None
+        scan = svc.journal.scan()
         for rid, res in sorted(report.done.items()):
             cfg = svc.pack.by_id(rid)
             # controller worlds: the solo twin replays the bucket's
@@ -132,14 +165,37 @@ def _finish(svc: SweepService, verify: bool) -> int:
             # survival law — docs/dispatch.md)
             decs = svc.decisions_for_world(rid, scan) \
                 if cfg.controller == "auto" else None
-            want = solo_result(cfg, lint="off", decisions=decs)
+            want, solo_tr = solo_result(cfg, lint="off",
+                                        decisions=decs,
+                                        with_trace=True)
             if want != res:
-                mismatches.append(
-                    {"run_id": rid, "solo": want, "streamed": res})
+                mm = {"run_id": rid, "solo": want, "streamed": res}
+                # auto-bisect the mismatch (obs/bisect.py): replay
+                # the world's journaled per-chunk digest trail
+                # against the solo twin's trace and name the first
+                # diverging chunk — "which chunk broke", not just
+                # "a digest differs"
+                mm.update(_auto_bisect(scan.chains.get(rid, []),
+                                       solo_tr))
+                mismatches.append(mm)
         out["verified"] = len(report.done) - len(mismatches)
         if mismatches:
             out["verify_mismatches"] = mismatches
             print(json.dumps(out))
+            for mm in mismatches:
+                d = mm.get("first_divergence")
+                sys.stderr.write(
+                    f"sweep --verify: {mm['run_id']}: "
+                    + (f"first diverging chunk {d['chunk']} "
+                       f"(supersteps {d['supersteps'][0]}.."
+                       f"{d['supersteps'][1]}): streamed "
+                       f"{d['streamed'][:12]}.. != solo "
+                       f"{str(d['solo'])[:12]}.."
+                       if d else
+                       "journaled chunk trail matches the solo "
+                       "trace — the divergence is outside the "
+                       "digested rows (counters/final state)")
+                    + "\n")
             sys.stderr.write(
                 "sweep survival law VIOLATED: streamed results "
                 "diverge from solo runs\n")
@@ -198,6 +254,11 @@ def _status(argv) -> int:
         # detected-and-rolled-back state corruptions (integrity/):
         # a nonzero count on real hardware means an SDC-prone host
         "integrity_violations": scan.integrity,
+        # per-world flight-recorder event counts (obs/flight.py) —
+        # present when the sweep ran with --record; the events
+        # themselves live in <journal>/events.jsonl (query with
+        # `timewarp-tpu explain`)
+        "flight_events": scan.flight,
         "pack_sha": scan.pack_sha}))
     return 0
 

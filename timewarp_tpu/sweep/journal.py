@@ -67,6 +67,17 @@ class JournalState:
     #: each one a detected state corruption that was rolled back —
     #: surfaced in `sweep status` so an SDC-prone host is visible
     integrity: List[dict] = field(default_factory=list)
+    #: run_id -> flight-recorder event count (flight_counts records,
+    #: sweep/runner.py; summed across processes — a resumed sweep
+    #: journals its own drain). Surfaced in `sweep status` next to
+    #: utilization when the sweep ran with --record
+    flight: Dict[str, int] = field(default_factory=dict)
+    #: run_id -> the world's per-chunk digest trail ([[supersteps,
+    #: chain_hex], ...], the world_done record's "chain" field) —
+    #: what --verify's auto-bisect feeds
+    #: obs.bisect.first_trail_divergence to name the first diverging
+    #: chunk on a survival-law mismatch
+    chains: Dict[str, list] = field(default_factory=dict)
 
     def decision_chain(self, bucket_id: str) -> List[dict]:
         """Every decision record governing ``bucket_id``'s worlds, in
@@ -201,6 +212,7 @@ class SweepJournal:
                         f"  second: {rec['result']}")
                 st.done[rid] = rec["result"]
                 st.world_bucket[rid] = rec.get("bucket", "")
+                st.chains[rid] = list(rec.get("chain", []))
             elif ev == "world_failed":
                 st.failed[rec["run_id"]] = rec
             elif ev == "bucket_done":
@@ -218,6 +230,12 @@ class SweepJournal:
             elif ev == "integrity_violation":
                 st.integrity.append(
                     {k: v for k, v in rec.items() if k != "ev"})
+            elif ev == "flight_counts":
+                # per-world recorded-event counts (sweep/runner.py):
+                # each process journals its own drain once per bucket
+                # run, so summing across records totals the sweep
+                for rid, n in rec.get("counts", {}).items():
+                    st.flight[rid] = st.flight.get(rid, 0) + int(n)
             elif ev == "dispatch_decision":
                 dl = st.decisions.setdefault(rec["bucket"], [])
                 d = rec["decision"]

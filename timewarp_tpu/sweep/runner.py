@@ -58,7 +58,8 @@ class BucketRunner:
                  done: Dict[str, dict], *, lint: str = "warn",
                  chunk: int = 64, inject=None,
                  telemetry: str = "off", metrics=None,
-                 prior_decisions=(), verify: str = "off") -> None:
+                 prior_decisions=(), verify: str = "off",
+                 record: str = "off", flight=None) -> None:
         self.bucket = bucket
         self.journal = journal
         #: shared run_id -> result map (journaled results land here
@@ -82,6 +83,25 @@ class BucketRunner:
         #: (the engine chunk-flushes `supersteps` lines into it)
         self.telemetry = telemetry
         self.metrics = metrics
+        #: causal flight recorder (obs/flight.py): bucket engines
+        #: built with record= thread the event plane; each chunk's
+        #: per-world logs drain into the shared ``flight`` writer
+        #: (<journal>/events.jsonl) tagged with the world's run_id,
+        #: and per-world event counts are journaled for `sweep
+        #: status`. Retried chunks may re-drain — events.jsonl is an
+        #: observability artifact, deliberately OUTSIDE the survival
+        #: law's compare surface (duplicates are harmless; the
+        #: superstep indices make them identifiable)
+        self.record = record
+        self.flight = flight
+        self.flight_counts: Dict[str, int] = {}
+        #: per-world [(supersteps, trace-digest chain), ...] trail —
+        #: the prefix values of the row chain at each chunk boundary.
+        #: Journaled on the world_done record (outside "result") and
+        #: persisted in checkpoint meta, it is what --verify's
+        #: auto-bisect compares against the solo twin to name the
+        #: first diverging chunk (obs/bisect.first_trail_divergence)
+        self.trails: Optional[List[list]] = None
         #: online state-integrity mode (integrity/, docs/integrity.md):
         #: "guard" builds the bucket engine with the on-device
         #: invariant plane; "digest" additionally keeps a per-world
@@ -164,7 +184,7 @@ class BucketRunner:
                     replay=self.prior_decisions)
             engine = build_bucket_engine(
                 self.bucket, lint=self.lint, telemetry=self.telemetry,
-                controller=ctrl,
+                controller=ctrl, record=self.record,
                 # digest mode includes the guard rung of the ladder
                 # (the in-scan invariants); the digest itself is this
                 # runner's chunk-boundary business
@@ -181,12 +201,15 @@ class BucketRunner:
             digests = list(meta["digests"])
             supersteps = [int(s) for s in meta["supersteps"]]
             chunks = int(meta.get("chunks", 0))
+            trails = [list(t) for t in meta["trail"]] \
+                if "trail" in meta else [[] for _ in range(B)]
         else:
             st = engine.init_state()
             meta = None
             digests = [DIGEST_ZERO] * B
             supersteps = [0] * B
             chunks = 0
+            trails = [[] for _ in range(B)]
         vdigests = vchain = None
         if self.verify == "digest":
             # a restored checkpoint must match the digests its meta
@@ -226,6 +249,7 @@ class BucketRunner:
             self.digests = digests
             self.supersteps = supersteps
             self.chunks = chunks
+            self.trails = trails
             self.vdigests = vdigests
             self.vchain = vchain
             self.emitted = set(self.done)
@@ -288,6 +312,7 @@ class BucketRunner:
         # snapshot the attempt's view; commits re-check the epoch
         st, digests = self.state, list(self.digests)
         supersteps = list(self.supersteps)
+        trails = [list(t) for t in self.trails]
         B = self.bucket.B
         _, remaining, active = eng.fleet_progress(st,
                                                   self.bucket.budgets)
@@ -303,10 +328,15 @@ class BucketRunner:
                 # RECORD, deliberately outside "result": the sweep
                 # survival law (and resume's replayed-record equality)
                 # compare results, which must stay bit-deterministic
+                # "chain" (the per-chunk digest trail) rides OUTSIDE
+                # "result" like wall_s/attempts: --verify's
+                # auto-bisect reads it, the survival law's compare
+                # surface never sees it
                 self.journal.append({"ev": "world_done",
                                      "bucket": self.bucket.bucket_id,
                                      "wall_s": round(self.wall_s, 6),
                                      "attempts": self.attempts,
+                                     "chain": trails[int(b)],
                                      "result": res})
                 self.done[cfg.run_id] = res
                 self.emitted.add(cfg.run_id)
@@ -359,6 +389,20 @@ class BucketRunner:
         for b in range(B):
             digests[b] = chain_digest(digests[b], traces[b])
             supersteps[b] += len(traces[b])
+            if len(traces[b]):
+                trails[b].append([supersteps[b], digests[b]])
+        if self.record != "off" and self.flight is not None \
+                and eng.last_run_flight is not None:
+            # drain this chunk's per-world events into the shared
+            # journal-dir event log, tagged by run_id (superstep
+            # indices are run-global — the engine state's step count)
+            for b, lg in enumerate(eng.last_run_flight):
+                if len(lg) == 0 and lg.dropped == 0:
+                    continue
+                rid = self.bucket.configs[b].run_id
+                self.flight.write(lg, world=b, run_id=rid)
+                self.flight_counts[rid] = \
+                    self.flight_counts.get(rid, 0) + len(lg)
         vdig2 = vchain2 = None
         if self.verify == "digest":
             # the new verified epoch: digest the post-chunk state and
@@ -375,6 +419,7 @@ class BucketRunner:
             self.state = new_state
             self.digests = digests
             self.supersteps = supersteps
+            self.trails = trails
             self.chunks = ci + 1
             self.wall_s += chunk_wall
             if vdig2 is not None:
@@ -399,6 +444,7 @@ class BucketRunner:
                     "run_ids": list(self.bucket.run_ids),
                     "digests": list(digests),
                     "supersteps": [int(s) for s in supersteps],
+                    "trail": [list(t) for t in trails],
                     "chunks": ci + 1}
             if vdig2 is not None:
                 # the verified-epoch extension of the existing sha256
@@ -452,6 +498,14 @@ class BucketRunner:
         with self._lock:
             self._check(epoch)
             self.journal.append({"ev": "bucket_util", **rec})
+            if self.record != "off":
+                # per-world flight-event counts (this process's) —
+                # `sweep status` surfaces them next to utilization
+                self.journal.append({
+                    "ev": "flight_counts",
+                    "bucket": self.bucket.bucket_id,
+                    "record": self.record,
+                    "counts": dict(self.flight_counts)})
             self.util["_journaled"] = True
         if self.metrics is not None:
             self.metrics.emit("utilization", **rec)
@@ -489,7 +543,8 @@ class BucketRunner:
                              telemetry=self.telemetry,
                              metrics=self.metrics,
                              prior_decisions=kid_decisions,
-                             verify=self.verify)
+                             verify=self.verify, record=self.record,
+                             flight=self.flight)
             if self.state is not None:
                 idx = np.asarray(idxs)
                 child_state = jax.tree.map(lambda x: x[idx], self.state)
@@ -499,6 +554,10 @@ class BucketRunner:
                         "digests": [self.digests[i] for i in idxs],
                         "supersteps": [self.supersteps[i]
                                        for i in idxs],
+                        "trail": [list(self.trails[i])
+                                  for i in idxs]
+                        if self.trails is not None
+                        else [[] for _ in idxs],
                         "chunks": self.chunks}
                 if self.vdigests is not None:
                     # world slices are exact (batch exactness law), so

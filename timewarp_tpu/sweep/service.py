@@ -232,7 +232,9 @@ class SweepService:
                  lint: str = "warn", inject=None,
                  telemetry: str = "off",
                  trace_out: Optional[str] = None,
-                 verify: str = "off") -> None:
+                 verify: str = "off",
+                 record: str = "off",
+                 post_verify: bool = False) -> None:
         if chunk < 1:
             raise ValueError(f"chunk must be >= 1, got {chunk}")
         if max_retries < 0:
@@ -270,15 +272,22 @@ class SweepService:
         self.inject = (InjectPlan(inject) if isinstance(inject, str)
                        else inject)
         if getattr(self.inject, "flip", None) \
-                and self.verify != "digest":
+                and self.verify != "digest" and not post_verify:
             # mirror of the solo CLI's guard: a flip without the
             # digest entry check would corrupt streamed results
             # SILENTLY (guard misses most planes by design) — the
-            # detection-law test would test nothing
+            # detection-law test would test nothing. A promised
+            # post-sweep --verify is the other legal arming: the
+            # survival-law check catches the corrupted stream and
+            # auto-bisects to the first diverging chunk
+            # (obs/bisect.py, docs/observability.md)
             raise ValueError(
                 "--inject flip: corrupts bucket state between "
-                "chunks; it needs --state-verify digest or the "
-                "corruption goes undetected into the journaled "
+                "chunks; it needs --state-verify digest (online "
+                "detection + rollback) or --verify (post-sweep "
+                "survival-law check, which auto-bisects the "
+                "mismatch to its first diverging chunk) — "
+                "anything less goes undetected into the journaled "
                 "results (docs/integrity.md)")
         # observability (obs/, docs/observability.md): when telemetry
         # is on, the bucket engines thread counter planes through
@@ -306,6 +315,21 @@ class SweepService:
                 lambda ev, dt: self.tracer.complete(
                     f"journal fsync: {ev}", dur_us=dt * 1e6,
                     cat="journal"))
+        # causal flight recorder per bucket (obs/flight.py,
+        # docs/observability.md): bucket engines thread the event
+        # plane (bit-exact — streamed results are mode-independent),
+        # and every chunk's per-world events drain into
+        # <journal>/events.jsonl tagged by run_id, queryable with
+        # `timewarp-tpu explain EVENTS --run-id ID`
+        from ..obs.flight import validate_record
+        self.record = validate_record(record, type(self).__name__)
+        self.flight = None
+        if self.record != "off":
+            from ..obs.flight import FlightWriter
+            self.journal.ensure_dir()
+            self.flight = FlightWriter(
+                _os.path.join(journal_dir, "events.jsonl"),
+                run=f"sweep:{pack.sha()[:12]}")
         self.done: Dict[str, dict] = {}
         self.failed: Dict[str, dict] = {}
         self._retries = 0
@@ -367,7 +391,8 @@ class SweepService:
                     bucket, self.journal, self.done, lint=self.lint,
                     chunk=self.chunk, inject=self.inject,
                     telemetry=self.telemetry, metrics=self.metrics,
-                    verify=self.verify,
+                    verify=self.verify, record=self.record,
+                    flight=self.flight,
                     # resume replays the journaled dispatch-decision
                     # chain (split-ancestor prefixes included) so a
                     # pre-kill decision is never re-made differently
@@ -637,6 +662,12 @@ class SweepService:
                     self.metrics.close()
                 except OSError as e:
                     _log.warning("sweep: metrics close failed: %s", e)
+            if self.flight is not None:
+                try:
+                    self.flight.close()
+                except OSError as e:
+                    _log.warning("sweep: flight-event log close "
+                                 "failed: %s", e)
             if self._executor is not None:
                 # never join: an abandoned wedged chunk must not keep
                 # a finished (or killed) sweep from returning

@@ -435,16 +435,20 @@ def world_result(cfg: RunConfig, state, b: Optional[int],
 
 
 def solo_result(cfg: RunConfig, *, lint: str = "warn",
-                decisions=None) -> Dict[str, Any]:
+                decisions=None, with_trace: bool = False):
     """Run ``cfg`` standalone and produce the exact record the sweep
     journal would stream for it — the right-hand side of the sweep
     survival law (tests/test_zsweep.py; the bench and CI smoke gates).
     Controller configs replay the bucket's journaled ``decisions``
-    (see :func:`solo_engine`)."""
+    (see :func:`solo_engine`). ``with_trace=True`` returns
+    ``(result, trace)`` so a ``--verify`` mismatch can auto-bisect
+    against the rows this run already computed instead of re-running
+    the whole solo twin."""
     eng = solo_engine(cfg, lint=lint, decisions=decisions)
     if cfg.controller == "auto":
         final, trace = eng.run_controlled(cfg.budget)
     else:
         final, trace = eng.run(cfg.budget)
-    return world_result(cfg, final, None,
-                        chain_digest(DIGEST_ZERO, trace), len(trace))
+    res = world_result(cfg, final, None,
+                       chain_digest(DIGEST_ZERO, trace), len(trace))
+    return (res, trace) if with_trace else res
