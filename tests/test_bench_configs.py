@@ -18,7 +18,8 @@ def test_bench_config_runs(cfg):
          "gossip_100k": 512, "gossip_100k_fused": 2048,
          "gossip_100k_insert": 2048,
          "gossip_100k_b8": 512, "gossip_100k_chaos": 512,
-         "gossip_100k_auto": 512, "gossip_100k_verify": 512,
+         "gossip_100k_auto": 512, "gossip_100k_spec": 512,
+         "gossip_100k_verify": 512,
          "gossip_100k_record": 512,
          "gossip_steady_1m": 512,
          "praos_1m": 512, "praos_1m_fused": 2048,
@@ -37,6 +38,16 @@ def test_bench_config_runs(cfg):
         # the JSON line: every world's schedule must actually bite
         assert all(v > 0 for v in extra["fault_dropped"])
         assert all(v == 0 for v in extra["route_drop"])
+    if cfg == "gossip_100k_spec":
+        # the optimistic-execution win gate (speculate/): a real
+        # superstep gain over the conservative floor AND an honest
+        # misspeculation ledger on the line (satellite 6 + the
+        # in-bench equivalence gate ran inside the config itself)
+        assert extra["speculation_gain_frac"] > 0
+        assert extra["supersteps_spec"] \
+            < extra["supersteps_conservative"]
+        assert 0.0 <= extra["rollback_rate"] <= 1.0
+        assert extra["rollbacks"] >= 0
     if cfg == "gossip_100k_record":
         # the flight-recorder config reports honest per-mode numbers
         # (obs/flight.py): both modes measured, events recorded, and

@@ -37,6 +37,15 @@ BAD_LINKS = [
     "quantize:a:fixed:1",        # non-numeric grid
     "quantize:5:uniform:1",      # damaged inner arity
     "never:1",                   # never takes no params
+    "pareto",                    # missing params
+    "pareto:4000",               # missing ALPHA
+    "pareto:a:1.5",              # non-numeric XM
+    "pareto:4000:x",             # non-numeric ALPHA
+    "pareto:0:1.5",              # XM must be >= 1
+    "pareto:4000:0",             # ALPHA must be > 0
+    "pareto:4000:-1.5",          # negative ALPHA
+    "pareto:4000:1.5:9",         # excess params
+    "quantize:500:pareto:4000",  # damaged inner pareto arity
 ]
 
 BAD_FAULTS = [
@@ -190,10 +199,48 @@ GOOD_LINKS = [
     "fixed:500",
     "uniform:1000:5000",
     "lognormal:5000:0.5",
+    "pareto:4000:1.5",
     "never",
     "drop:0.25:quantize:1000:uniform:1000:5000",
     "quantize:1000:lognormal:5000:0.5",
+    "quantize:500:pareto:4000:1.2",
 ]
+
+
+# ---------------------------------------------------------------------------
+# the --speculate grammar (speculate/, ISSUE 12)
+# ---------------------------------------------------------------------------
+
+BAD_SPECULATES = [
+    "",                          # empty spec
+    "Auto",                      # case matters (a typo, not a mode)
+    "on",                        # unknown mode
+    "fixed",                     # bare fixed (no width)
+    "fixed:",                    # empty width
+    "fixed:abc",                 # non-numeric width
+    "fixed:1",                   # W=1 is the classic engine
+    "fixed:-500",                # negative width
+    "auto:3",                    # auto takes no parameters
+]
+
+
+@pytest.mark.parametrize("spec", BAD_SPECULATES)
+def test_malformed_speculate_specs_name_the_grammar(spec):
+    from timewarp_tpu.speculate import (SPECULATE_GRAMMAR,
+                                        parse_speculate)
+    with pytest.raises(ValueError) as ei:
+        parse_speculate(spec)
+    msg = str(ei.value)
+    assert "grammar" in msg and SPECULATE_GRAMMAR in msg, \
+        f"{spec!r} died without naming SPECULATE_GRAMMAR: {msg}"
+
+
+def test_good_speculate_specs_parse():
+    from timewarp_tpu.speculate import parse_speculate
+    assert parse_speculate(None) == ("off", None)
+    assert parse_speculate("off") == ("off", None)
+    assert parse_speculate("auto") == ("auto", None)
+    assert parse_speculate("fixed:8000") == ("fixed", 8000)
 
 GOOD_FAULTS = [
     "crash:3:5s:9s",

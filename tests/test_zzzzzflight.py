@@ -273,7 +273,9 @@ def test_writer_loader_roundtrip(tmp_path):
 
 def test_metrics_v4_flight_event_form():
     from timewarp_tpu.obs.metrics import METRICS_SCHEMA, validate_line
-    assert METRICS_SCHEMA == 4
+    # v4 introduced the flight event form; later purely-additive
+    # bumps (v5 = the speculation kind) must keep validating it
+    assert METRICS_SCHEMA >= 4
     good = {"schema": 4, "kind": "event", "name": "flight",
             "ev": "deliver", "superstep": 3, "src": 1, "dst": 2,
             "send_t_us": -1, "t_us": 5000}

@@ -78,64 +78,11 @@ def build_batch(args):
         raise SystemExit(str(e)) from None
 
 
-#: the --link grammar, named in every parse error
-LINK_GRAMMAR = ("fixed:D | uniform:LO:HI | lognormal:MEDIAN:SIGMA | "
-                "drop:P:<inner> | quantize:Q:<inner> | never  "
-                "(D/LO/HI/MEDIAN/Q integer µs; P/SIGMA float; "
-                "never = drop probability 1, the old NeverConnected)")
-
-
-def parse_link(spec: str):
-    """``fixed:D`` | ``uniform:LO:HI`` | ``lognormal:MEDIAN:SIGMA`` —
-    optionally wrapped ``drop:P:<inner>`` and/or ``quantize:Q:<inner>``;
-    ``never`` is the fully-severed link (``WithDrop(..,
-    NEVER_CONNECTED)`` ≙ the reference's ``NeverConnected`` outcome).
-    Malformed specs die with a message naming the grammar, never a raw
-    IndexError/ValueError."""
-    from .net.delays import (NEVER_CONNECTED, FixedDelay, LogNormalDelay,
-                             Quantize, UniformDelay, WithDrop)
-    parts = spec.split(":")
-    kind = parts[0]
-    try:
-        if kind == "never":
-            if len(parts) != 1:
-                raise ValueError("never takes no parameters (every "
-                                 "message is dropped)")
-            return WithDrop(FixedDelay(1), NEVER_CONNECTED)
-        if kind == "drop":
-            if len(parts) < 3 or not parts[2]:
-                raise ValueError("drop needs a probability and an "
-                                 "inner spec")
-            return WithDrop(parse_link(":".join(parts[2:])),
-                            float(parts[1]))
-        if kind == "quantize":
-            if len(parts) < 3 or not parts[2]:
-                raise ValueError("quantize needs a grid and an "
-                                 "inner spec")
-            return Quantize(parse_link(":".join(parts[2:])),
-                            int(parts[1]))
-        if kind == "fixed":
-            if len(parts) != 2:
-                raise ValueError("fixed takes exactly one delay")
-            return FixedDelay(int(parts[1]))
-        if kind == "uniform":
-            if len(parts) != 3:
-                raise ValueError("uniform takes exactly LO and HI")
-            return UniformDelay(int(parts[1]), int(parts[2]))
-        if kind == "lognormal":
-            if len(parts) != 3:
-                raise ValueError("lognormal takes exactly MEDIAN "
-                                 "and SIGMA")
-            return LogNormalDelay(int(parts[1]), float(parts[2]))
-    except SystemExit:
-        raise                   # an inner spec already produced the
-    except (IndexError, ValueError) as e:        # grammar-named error
-        raise SystemExit(
-            f"malformed link spec {spec!r} ({e}); "
-            f"grammar: {LINK_GRAMMAR}") from None
-    raise SystemExit(
-        f"unknown link spec kind {kind!r} in {spec!r}; "
-        f"grammar: {LINK_GRAMMAR}")
+# the --link grammar + parser live with the link models they build
+# (net/links.py — ONE module serving the CLI and the sweep pack
+# loader, so the grammar cannot drift between surfaces); re-exported
+# here because this was their historical import path
+from .net.links import LINK_GRAMMAR, parse_link  # noqa: F401,E402
 
 
 def build_scenario(args):
@@ -179,6 +126,12 @@ def build_faults(args):
 #: docs/dispatch.md) — the chunk-capable jitted engines
 CONTROLLER_ENGINES = ("general", "edge", "fused-sparse",
                       "sharded-batched")
+
+#: engines that speculate (speculate/, docs/speculation.md) — the
+#: chunk-capable engines that thread the DYNAMIC per-superstep window
+#: (edge runs classic W=1 supersteps; the fused/pallas kernels bake
+#: the window into kernel arithmetic — no clamp point, no rollback)
+SPECULATE_ENGINES = ("general", "sharded-batched")
 
 
 def build_controller(args):
@@ -228,6 +181,21 @@ def build_engine(args, sc, link):
             f"--controller drives the chunk-capable jitted engines "
             f"({', '.join(CONTROLLER_ENGINES)}); {args.engine} has "
             "no chunked scan driver to adapt (docs/dispatch.md)")
+    speculate = getattr(args, "speculate", "off")
+    if speculate != "off" and args.engine not in SPECULATE_ENGINES:
+        raise SystemExit(
+            f"--speculate threads the dynamic per-superstep window "
+            f"through the XLA scan engines "
+            f"({', '.join(SPECULATE_ENGINES)}); {args.engine} cannot "
+            "(edge runs classic supersteps; the fused/pallas kernels "
+            "bake the window; the oracle is host Python — "
+            "docs/speculation.md)")
+    if speculate != "off" and getattr(args, "insert", None) \
+            in ("pallas", "interpret"):
+        raise SystemExit(
+            "--speculate needs the dynamic window clamp; "
+            f"--insert {args.insert} bakes the window into kernel "
+            "arithmetic (docs/speculation.md)")
     if telemetry != "off" and args.engine == "oracle":
         raise SystemExit(
             "--telemetry threads on-device counter planes through the "
@@ -303,25 +271,45 @@ def build_engine(args, sc, link):
                                faults=faults)
     if args.engine == "general":
         from .interp.jax_engine.engine import JaxEngine
-        return JaxEngine(sc, link, seed=args.seed, window=args.window,
-                         route_cap=args.route_cap,
-                         record_events=args.record_events,
-                         lint=args.lint, batch=batch, faults=faults,
-                         telemetry=telemetry,
-                         insert=getattr(args, "insert", None),
-                         insert_cap=getattr(args, "insert_cap", None),
-                         controller=controller,
-                         verify=verify, record=record,
-                         record_cap=record_cap)
+        try:
+            return JaxEngine(sc, link, seed=args.seed,
+                             window=args.window,
+                             route_cap=args.route_cap,
+                             record_events=args.record_events,
+                             lint=args.lint, batch=batch,
+                             faults=faults,
+                             telemetry=telemetry,
+                             insert=getattr(args, "insert", None),
+                             insert_cap=getattr(args, "insert_cap",
+                                                None),
+                             controller=controller,
+                             verify=verify, record=record,
+                             record_cap=record_cap,
+                             speculate=speculate)
+        except ValueError as e:
+            # construction-time speculation guards (fixed:W under the
+            # floor, conflicting decision sources) are grammar-class
+            # errors for a CLI caller — clean exit, not a traceback
+            if speculate != "off":
+                raise SystemExit(str(e)) from None
+            raise
     if args.engine == "sharded-batched":
         from .interp.jax_engine.sharded import (ShardedBatchedEngine,
                                                 make_mesh)
-        return ShardedBatchedEngine(
-            sc, link, make_mesh(args.devices, axis="worlds"),
-            batch=batch, seed=args.seed, window=args.window,
-            route_cap=args.route_cap, lint=args.lint, faults=faults,
-            telemetry=telemetry, controller=controller,
-            verify=verify, record=record, record_cap=record_cap)
+        try:
+            return ShardedBatchedEngine(
+                sc, link, make_mesh(args.devices, axis="worlds"),
+                batch=batch, seed=args.seed, window=args.window,
+                route_cap=args.route_cap, lint=args.lint,
+                faults=faults, telemetry=telemetry,
+                controller=controller, verify=verify, record=record,
+                record_cap=record_cap, speculate=speculate)
+        except ValueError as e:
+            # same clean-exit contract as the general path: a
+            # speculation misconfiguration is a grammar-class error
+            if speculate != "off":
+                raise SystemExit(str(e)) from None
+            raise
     if args.engine == "fused-sparse":
         from .interp.jax_engine.fused_sparse import FusedSparseEngine
         kw = {} if args.max_batch is None else {
@@ -700,6 +688,29 @@ def main(argv=None) -> int:
                         "— a seeded bit-flip written into a state "
                         "plane between chunks (needs --verify; "
                         "docs/integrity.md)")
+    p.add_argument("--speculate", default="off",
+                   help="optimistic time-warp execution (speculate/, "
+                        "docs/speculation.md): 'auto' ladders the "
+                        "superstep window up past the provable link "
+                        "floor, detecting causality violations "
+                        "on-device and rolling back to the "
+                        "conservative floor; 'fixed:W' speculates at "
+                        "exactly W µs; 'off' (default) the static "
+                        "window. Runs the run_speculative chunked "
+                        "driver; the committed window choices form a "
+                        "decision trace (--decisions-out)")
+    p.add_argument("--speculate-chunk", type=int, default=None,
+                   help="supersteps per speculative chunk (the "
+                        "rollback granularity), default 64 "
+                        "(needs --speculate)")
+    p.add_argument("--canon-out", default=None,
+                   help="write the run's canonical equivalence "
+                        "surface (speculate/equiv.py: granularity-"
+                        "invariant trace aggregates + never-silent "
+                        "counters + final-state sha, one CSV row per "
+                        "world) — `cmp` a speculative run's file "
+                        "against the conservative run's to check the "
+                        "speculation equivalence law byte-for-byte")
     args = p.parse_args(argv)
     if args.telemetry == "off" and (args.metrics_out or args.trace_out):
         raise SystemExit(
@@ -715,9 +726,19 @@ def main(argv=None) -> int:
             "--record-cap sizes the flight recorder's per-superstep "
             "event plane; pass --record deliveries|full (the knob "
             "would be silently ignored)")
-    if args.decisions_out and args.controller == "off":
+    if args.decisions_out and args.controller == "off" \
+            and getattr(args, "speculate", "off") == "off":
         raise SystemExit("--decisions-out needs --controller "
-                         "auto|replay:* (static runs decide nothing)")
+                         "auto|replay:* or --speculate auto|fixed:W "
+                         "(static runs decide nothing)")
+    if args.canon_out and args.engine in ("oracle", "edge",
+                                          "sharded-edge"):
+        raise SystemExit(
+            "--canon-out digests an EngineState's canonical surface "
+            "(speculate/equiv.py); the oracle keeps host-side state "
+            "and the edge engines carry EdgeState (different counter "
+            "layout) — run a general-family engine (bit-identical by "
+            "the parity/sharding laws)")
     if args.controller != "off" and args.resume:
         raise SystemExit(
             "--controller and --resume cannot combine: decision "
@@ -750,6 +771,41 @@ def main(argv=None) -> int:
             "(run_verified); --controller runs the adaptive one — "
             "combine them via the sweep service (--state-verify, "
             "docs/integrity.md). --verify guard rides any driver")
+    if args.speculate != "off":
+        from .speculate import parse_speculate
+        try:
+            parse_speculate(args.speculate, who="--speculate")
+        except ValueError as e:
+            raise SystemExit(str(e)) from None
+        if args.controller != "off":
+            raise SystemExit(
+                "--speculate and --controller are both per-chunk "
+                "window decision sources — pick one "
+                "(docs/speculation.md)")
+        if args.verify in ("digest", "shadow"):
+            raise SystemExit(
+                "--speculate runs the optimistic chunked driver "
+                "(run_speculative); --verify digest|shadow runs the "
+                "verified one — combine them via the sweep service "
+                "(--state-verify + --speculate, docs/speculation.md)."
+                " --verify guard rides any driver")
+        if args.resume:
+            raise SystemExit(
+                "--speculate and --resume cannot combine: decision "
+                "traces index chunks from the run start — "
+                "checkpointed speculative runs are the sweep "
+                "service's business (timewarp-tpu sweep --speculate, "
+                "docs/speculation.md)")
+    if args.speculate_chunk is not None:
+        if args.speculate == "off":
+            raise SystemExit(
+                "--speculate-chunk shapes the optimistic chunked "
+                "driver; pass --speculate auto|fixed:W (the knob "
+                "would be silently ignored)")
+        if args.speculate_chunk < 1:
+            raise SystemExit(
+                f"--speculate-chunk must be >= 1, got "
+                f"{args.speculate_chunk}")
     if args.verify_chunk is not None \
             and args.verify not in ("digest", "shadow"):
         raise SystemExit(
@@ -856,6 +912,22 @@ def main(argv=None) -> int:
             if engine.controller is not None:
                 final, trace = engine.run_controlled(args.steps,
                                                      state=state)
+            elif args.speculate != "off":
+                # the optimistic chunked driver (speculate/,
+                # docs/speculation.md): per-chunk speculative windows
+                # with causality-violation rollback. Library guards
+                # (a floor violation — the link model's declared
+                # minimum lied) exit clean — they name the
+                # misconfiguration, and a CLI traceback would bury
+                # the one-line diagnostic
+                from .speculate import SpeculationViolation
+                try:
+                    final, trace = engine.run_speculative(
+                        args.steps, state=state,
+                        chunk=(64 if args.speculate_chunk is None
+                               else args.speculate_chunk))
+                except SpeculationViolation as e:
+                    raise SystemExit(str(e)) from None
             elif args.verify in ("digest", "shadow"):
                 # the self-verifying chunked driver (integrity/,
                 # docs/integrity.md): per-chunk digest / shadow
@@ -993,6 +1065,29 @@ def main(argv=None) -> int:
             from .dispatch import DecisionTrace
             DecisionTrace.of(decs).save(args.decisions_out)
             summary["controller"]["out"] = args.decisions_out
+    if args.speculate != "off":
+        # the speculation receipt: committed windows, the honest
+        # rollback count, and the conservative floor the run would
+        # have been stuck at — the CLI face of last_run_speculation
+        si = dict(engine.last_run_speculation or {})
+        si.pop("violations", None)   # scalars only on the one line
+        summary["speculation"] = {"spec": args.speculate, **si}
+        if args.decisions_out:
+            from .dispatch import DecisionTrace
+            DecisionTrace.of(engine.last_run_decisions or []).save(
+                args.decisions_out)
+            summary["speculation"]["out"] = args.decisions_out
+    if args.canon_out:
+        # the equivalence-law surface (speculate/equiv.py): byte-
+        # deterministic, so `cmp speculative.csv conservative.csv`
+        # IS the law check — any event-level divergence moves the
+        # aggregates
+        from .speculate import canonical_rows, write_canon_csv
+        B = None if getattr(engine, "batch", None) is None \
+            else engine.batch.B
+        write_canon_csv(args.canon_out,
+                        canonical_rows(final, trace, B))
+        summary["canon"] = args.canon_out
     print(json.dumps(summary))
     return 0
 

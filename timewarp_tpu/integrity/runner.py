@@ -119,7 +119,7 @@ class VerifiedRunMixin:
 
     def run_verified(self, budgets, state=None, *, chunk: int = 64,
                      cadence: int = 1, inject=None,
-                     max_rollbacks: int = 3):
+                     max_rollbacks: int = 3, on_quiesce=None):
         """Run to quiescence/budget under the engine's ``verify``
         mode, chunk by chunk, rolling back to the last verified
         snapshot on any detection (module docstring). Accepts the
@@ -129,7 +129,13 @@ class VerifiedRunMixin:
         ``run``. ``inject`` is the deterministic-corruption test hook
         (integrity/inject.py ``FlipInjector``): called as
         ``inject(chunk_idx, state)`` between chunks, it may return a
-        corrupted replacement state. The integrity record lands on
+        corrupted replacement state. ``on_quiesce(b, state)`` fires
+        exactly once per world (``b=0`` solo), the moment the world
+        has quiesced or exhausted its budget at a VERIFIED boundary —
+        evaluated on committed states only and before the injection
+        hook, so a rolled-back chunk can never fire (or double-fire)
+        it: the rollback × streaming contract
+        (tests/test_zzzzzzspec.py). The integrity record lands on
         ``last_run_integrity`` (and the digest chain on
         ``last_run_stats['digest_chain']``)."""
         from ..trace.events import SuperstepTrace
@@ -223,10 +229,42 @@ class VerifiedRunMixin:
                              mode=mode, chunk=int(v["chunk"]),
                              event="rollback")
 
+        emitted = np.zeros(nworld, bool)
         ci = 0
         while True:
             _, remaining, active = self._controlled_progress(
                 st, budgets, start)
+            act = np.atleast_1d(np.asarray(active))
+            newly = ~act & ~emitted
+            if newly.any() and digest_on:
+                # the emission below promises a VERIFIED state: an
+                # in-place corruption since the last commit (the
+                # digest mode's whole threat model — e.g. a corrupted
+                # wake flipping world_active) must not fire the
+                # exactly-once callback with a corrupt state, so the
+                # entry digest check runs FIRST on quiesce
+                # transitions (rare — once per world; the regular
+                # every-chunk entry check below is untouched)
+                from .digest import first_digest_mismatch
+                hit = first_digest_mismatch(self._state_digests(st),
+                                            vdig)
+                if hit is not None:
+                    bad, got_h, want_h = hit
+                    rollback({
+                        "chunk": ci, "kind": "entry_digest",
+                        "world": bad if batch is not None else None,
+                        "expected": want_h, "got": got_h})
+                    continue
+            for b in np.nonzero(newly)[0]:
+                # `st` here is the last VERIFIED state (rollback
+                # restores it before the loop re-enters, and the
+                # digest guard above re-checks it at rest), so a
+                # tainted chunk can never quiesce a world — and the
+                # emitted ledger makes the callback exactly-once even
+                # across rollbacks of later chunks
+                emitted[int(b)] = True
+                if on_quiesce is not None:
+                    on_quiesce(int(b), st)
             if not np.any(active):
                 break
             if inject is not None:

@@ -130,7 +130,13 @@ class StepOut(NamedTuple):
     ``rec`` is the causal flight recorder's bounded event plane
     (obs/flight.py ``RecordRow``) — ``None`` unless ``record !=
     "off"``; the same None-default contract again
-    (tests/test_zzzzzflight.py)."""
+    (tests/test_zzzzzflight.py).
+
+    ``spec`` is the optimistic-execution causality-violation plane
+    (speculate/plane.py ``SpecRow``) — ``None`` unless ``speculate !=
+    "off"``; the same None-default contract, so the speculate-off
+    jaxpr is byte-identical to the pre-knob engine
+    (tests/test_zzzzzzspec.py)."""
     valid: jax.Array
     t: jax.Array
     fired_count: jax.Array
@@ -143,6 +149,7 @@ class StepOut(NamedTuple):
     telem: Any = None
     integ: Any = None
     rec: Any = None
+    spec: Any = None
 
 
 class LocalComm:

@@ -56,6 +56,7 @@ from .common import thi as _thi, tlo as _tlo, u32sum as _u32sum
 from .controlled import ControlledRunMixin
 from ...integrity.runner import VerifiedRunMixin
 from ...obs.flight import FlightRecorderMixin
+from ...speculate.runner import SpeculativeRunMixin
 
 __all__ = ["JaxEngine", "EngineState", "BatchSpec"]
 
@@ -117,7 +118,7 @@ class EngineState(NamedTuple):
 
 
 class JaxEngine(RunStatsMixin, ControlledRunMixin, VerifiedRunMixin,
-                FlightRecorderMixin):
+                FlightRecorderMixin, SpeculativeRunMixin):
     """Single-chip batched engine for arbitrary (dynamic-destination)
     scenarios. ``run(max_steps)`` executes up to ``max_steps``
     supersteps under one ``lax.scan`` and returns the final
@@ -263,7 +264,8 @@ class JaxEngine(RunStatsMixin, ControlledRunMixin, VerifiedRunMixin,
                  controller=None,
                  verify: str = "off",
                  record: str = "off",
-                 record_cap: Optional[int] = None) -> None:
+                 record_cap: Optional[int] = None,
+                 speculate: str = "off") -> None:
         # static scenario sanitizer (analysis/): "warn" logs findings,
         # "error" refuses to construct on contract violations, "off"
         # skips entirely (bit-for-bit the pre-lint behavior — the
@@ -291,6 +293,16 @@ class JaxEngine(RunStatsMixin, ControlledRunMixin, VerifiedRunMixin,
         # delivered message; "full" adds sends and fault actions
         # (defer/cut/down/purge/restart)
         self._bind_record(record, record_cap)
+        # optimistic time-warp execution (speculate/,
+        # docs/speculation.md): "off" lowers to the exact
+        # speculation-free jaxpr (the violation plane is a None
+        # StepOut field, like telemetry); "auto"/"fixed:W" permit a
+        # window BOUND wider than the provable link floor and thread
+        # the causality-violation plane — resolved below, after the
+        # insert strategy fixes _dyn_ok and the link floor is known
+        from ...speculate.plane import parse_speculate
+        self.speculate, self._spec_w = parse_speculate(
+            speculate, type(self).__name__)
         #: attachable obs.metrics.MetricsRegistry: when set, every
         #: traced run flushes one aggregated `supersteps` line (per
         #: world, batched) under `metrics_label`
@@ -364,8 +376,12 @@ class JaxEngine(RunStatsMixin, ControlledRunMixin, VerifiedRunMixin,
             # forced onto the schedule-wide conservative floor
             # (docs/dispatch.md). An engine whose window is a kernel
             # constant has no clamp point — it MUST take the degraded
-            # floor like any static engine.
-            if controller is None or not self._dyn_ok:
+            # floor like any static engine. Speculating engines keep
+            # the undegraded floor the same way: run_speculative
+            # always threads the dynamic window, so the device clamp
+            # is in force (docs/speculation.md).
+            if (controller is None and self.speculate == "off") \
+                    or not self._dyn_ok:
                 link_floor = self.faults.min_delay_floor(link_floor)
         if isinstance(window, str) and window != "auto":
             # a typo'd "Auto"/"8ms" from a library caller would
@@ -388,12 +404,68 @@ class JaxEngine(RunStatsMixin, ControlledRunMixin, VerifiedRunMixin,
         if window < 1:
             raise ValueError(f"window must be >= 1 µs, got {window}")
         if window > 1 and window > link_floor:
+            # under speculation the window argument names the
+            # CONSERVATIVE floor, so the actionable advice differs:
+            # the speculative bound is the speculate spec's business
+            hint = (
+                "speculate= is already on and window= names its "
+                "CONSERVATIVE floor, which must stay provable (<= "
+                "the declared min); put the speculative bound in the "
+                "spec instead — speculate='fixed:W', or 'auto' to "
+                "ladder it (docs/speculation.md)"
+            ) if self.speculate != "off" else (
+                "to run wider than the provable floor, speculate: "
+                "speculate='auto'|'fixed:W' detects and rolls back "
+                "the violations statically ruled out here "
+                "(docs/speculation.md)")
             raise ValueError(
                 f"window={window} µs exceeds the link model's declared "
                 f"min_delay_us={link_floor}"
                 f"{' (min over the batch worlds)' if batch else ''}; "
                 "windowed supersteps would reorder causally dependent "
-                "events (engine.py windowed-execution precondition)")
+                f"events (engine.py windowed-execution precondition) "
+                f"— {hint}")
+        # optimistic execution (speculate/, docs/speculation.md):
+        # `window` validated above is the CONSERVATIVE floor — the
+        # widest statically provable window; the engine's `window`
+        # attribute becomes the speculative BOUND beyond it. The
+        # causality-violation plane (SpecRow riding StepOut) is the
+        # dynamic replacement for the static check just skipped:
+        # every committed superstep proves flight >= its effective
+        # window, which re-establishes the exactness precondition
+        # chunk by chunk (run_speculative rolls back the rest).
+        self.spec_floor = None
+        if self.speculate != "off":
+            if not self._dyn_ok:
+                raise ValueError(
+                    f"speculate={speculate!r} threads the dynamic "
+                    f"per-superstep window; insert={self.insert!r} "
+                    "bakes the window into kernel arithmetic and has "
+                    "no clamp point — run speculation on the XLA "
+                    "insert strategies (docs/speculation.md)")
+            if controller is not None:
+                raise ValueError(
+                    "speculate and controller are both per-chunk "
+                    "window decision sources — an engine runs under "
+                    "exactly one (docs/speculation.md)")
+            self.spec_floor = int(window)
+            if self.speculate == "fixed":
+                if self._spec_w <= self.spec_floor:
+                    raise ValueError(
+                        f"speculate='fixed:{self._spec_w}' does not "
+                        f"exceed the conservative floor "
+                        f"{self.spec_floor} µs — at or below the "
+                        "floor the static window already proves "
+                        "exactness; nothing to speculate "
+                        "(docs/speculation.md)")
+                window = self._spec_w
+            else:
+                # auto: the bound is the widest representable window
+                # — the ladder policy (speculate/policy.py) doubles
+                # up from the floor and backs off below the first
+                # width that violates, so the bound is a ceiling, not
+                # a target
+                window = _I32MAX - 1
         if window >= _I32MAX:
             raise ValueError("window must fit int32")
         if route_cap is not None and route_cap < 1:
@@ -658,9 +730,17 @@ class JaxEngine(RunStatsMixin, ControlledRunMixin, VerifiedRunMixin,
         # the violation, not one shorter than the bound
         short = jnp.sum(ok & (flight < self._w_now), dtype=jnp.int32) \
             if self.window > 1 else jnp.int32(0)
+        # the causality plane's straggler column (speculate/,
+        # docs/speculation.md): earliest offending absolute delivery
+        # time among this call's violations — None (no jaxpr
+        # footprint) unless the engine speculates
+        strag = None
+        if self.speculate != "off" and self.window > 1:
+            strag = jnp.min(jnp.where(ok & (flight < self._w_now),
+                                      tmsg + flight, jnp.int64(NEVER)))
         drel = jnp.minimum(drel64,
                            jnp.int64(_I32MAX - 1)).astype(jnp.int32)
-        return flight, drel, bad, short
+        return flight, drel, bad, short, strag
 
     def _insert_sorted(self, mb_rel, mb_src, mb_payload, sd, ok_s,
                        drel_s, src_s, pay_s, free_rows, counts):
@@ -814,7 +894,7 @@ class JaxEngine(RunStatsMixin, ControlledRunMixin, VerifiedRunMixin,
                     if W > 1 else jnp.zeros((SA,), jnp.int32)
                 src_l = smrank // jnp.int32(M)
                 tmsg_l = t + woff_f.astype(jnp.int64)
-                flight, drel, bad_delay_step, short_step = \
+                flight, drel, bad_delay_step, short_step, strag = \
                     self._sample_nodrop(src_l, dst_f, tmsg_l,
                                         smrank % jnp.int32(M),
                                         woff_f, ok)
@@ -852,6 +932,11 @@ class JaxEngine(RunStatsMixin, ControlledRunMixin, VerifiedRunMixin,
                 ret = (mrel, msrc, mpay, overflow_step, bad_dst_step,
                        bad_delay_step, short_step, jnp.int32(0),
                        sent_count, sent_hash, fault_cut + fault_down)
+                if strag is not None:
+                    # the causality plane's straggler min rides the
+                    # switch return like the send capture below (the
+                    # one legal exit for a branch-scoped value)
+                    ret += (strag,)
                 if rec_full:
                     # send capture rides the switch return (the one
                     # legal exit for a branch-scoped value) — pre-down
@@ -885,7 +970,7 @@ class JaxEngine(RunStatsMixin, ControlledRunMixin, VerifiedRunMixin,
                 tmsg_s = t + woff_s.astype(jnp.int64)
                 # sample only the rung's lanes; invalid lanes are fed
                 # the sentinel and masked (`sample` is elementwise)
-                flight_s, drel_s, bad_delay_step, short_step = \
+                flight_s, drel_s, bad_delay_step, short_step, strag = \
                     self._sample_nodrop(src_s, sd, tmsg_s,
                                         smrank_s % jnp.int32(M),
                                         woff_s, ok_s)
@@ -906,6 +991,8 @@ class JaxEngine(RunStatsMixin, ControlledRunMixin, VerifiedRunMixin,
                 ret = (mrel, msrc, mpay, overflow_step, bad_dst_step,
                        bad_delay_step, short_step, jnp.int32(0),
                        sent_count, sent_hash)
+                if strag is not None:
+                    ret += (strag,)
                 if rec_full:
                     ret += (self._rec_sends(ok_s, None, src_s, sd,
                                             tmsg_s,
@@ -1004,7 +1091,7 @@ class JaxEngine(RunStatsMixin, ControlledRunMixin, VerifiedRunMixin,
             from ...faults.apply import down_mask
             src_l = smrank // jnp.int32(M)
             tmsg_l = t + woff_f.astype(jnp.int64)
-            flight, drel, bad_delay_step, short_step = \
+            flight, drel, bad_delay_step, short_step, _ = \
                 self._sample_nodrop(src_l, dst_f, tmsg_l,
                                     smrank % jnp.int32(M), woff_f, ok)
             downm = ok & down_mask(self._ft, dst_f, tmsg_l + flight)
@@ -1060,7 +1147,7 @@ class JaxEngine(RunStatsMixin, ControlledRunMixin, VerifiedRunMixin,
         ok_s = sd < n
         src_s = smrank_s // jnp.int32(M)
         tmsg_s = t + woff_s.astype(jnp.int64)
-        flight_s, drel_s, bad_delay_step, short_step = \
+        flight_s, drel_s, bad_delay_step, short_step, _ = \
             self._sample_nodrop(src_s, sd, tmsg_s,
                                 smrank_s % jnp.int32(M), woff_s, ok_s)
         mrel, msrc, mpay, overflow_step = self._insert_sorted(
@@ -1352,6 +1439,13 @@ class JaxEngine(RunStatsMixin, ControlledRunMixin, VerifiedRunMixin,
                 # it into this superstep's capture order
                 self._rec_extra.append(res[-1])
                 res = res[:-1]
+            spec_strag = None
+            if self.speculate != "off":
+                # the causality plane's straggler min rode the switch
+                # return the same way (speculating engines always take
+                # _route_adaptive — the kernel routes refuse the knob)
+                spec_strag = res[-1]
+                res = res[:-1]
             (mb_rel, mb_src, mb_payload, overflow_step, bad_dst_step,
              bad_delay_step, short_step, route_drop_step, sent_count,
              sent_hash) = res[:10]
@@ -1365,7 +1459,7 @@ class JaxEngine(RunStatsMixin, ControlledRunMixin, VerifiedRunMixin,
                 overflow_step, bad_dst_step, bad_delay_step, short_step,
                 route_drop_step, sent_count, sent_hash, with_trace,
                 fault_dropped_step=fault_purged + fault_route,
-                restart_done=restart_done)
+                restart_done=restart_done, spec_strag=spec_strag)
         S = n * M
         src_f = jnp.tile(node_ids, M)
         slot_f = jnp.repeat(jnp.arange(M, dtype=jnp.int32), n)
@@ -1440,7 +1534,7 @@ class JaxEngine(RunStatsMixin, ControlledRunMixin, VerifiedRunMixin,
             tmsg_s = t + woff_s.astype(jnp.int64)
             # sample the survivors; invalid lanes (sd == n) are fed the
             # sentinel and masked — `sample` is elementwise by contract
-            flight_s, drel_s, bad_delay_step, short_step = \
+            flight_s, drel_s, bad_delay_step, short_step, spec_strag = \
                 self._sample_nodrop(src_s, sd, tmsg_s,
                                     smrank_s % jnp.int32(M), woff_s,
                                     ok_s)
@@ -1480,6 +1574,15 @@ class JaxEngine(RunStatsMixin, ControlledRunMixin, VerifiedRunMixin,
             short_step = comm.all_sum(jnp.sum(
                 ok & (flight < self._w_now), dtype=jnp.int32)) \
                 if W > 1 else jnp.int32(0)
+            # the causality plane's straggler column — same set as
+            # short_step (post-cut, pre-down: a down-dropped straggler
+            # never lands, but the detector stays conservative and
+            # flags the send anyway, docs/speculation.md)
+            spec_strag = None
+            if self.speculate != "off" and W > 1:
+                spec_strag = comm.all_min(jnp.min(jnp.where(
+                    ok & (flight < self._w_now), tmsg + flight,
+                    jnp.int64(NEVER))))
             drel = jnp.minimum(drel64,
                                jnp.int64(_I32MAX - 1)).astype(jnp.int32)
             if self._faulted:
@@ -1562,14 +1665,15 @@ class JaxEngine(RunStatsMixin, ControlledRunMixin, VerifiedRunMixin,
             overflow_step, bad_dst_step, bad_delay_step, short_step,
             route_drop_step, sent_count, sent_hash, with_trace,
             fault_dropped_step=fault_purged + fault_eager,
-            restart_done=restart_done)
+            restart_done=restart_done, spec_strag=spec_strag)
 
     def _finish_superstep(self, st, live, states, wake, mb_rel, mb_src,
                           mb_payload, deliver, fire, node_ids, t, base,
                           now_vec, overflow_step, bad_dst_step,
                           bad_delay_step, short_step, route_drop_step,
                           sent_count, sent_hash, with_trace,
-                          fault_dropped_step=None, restart_done=None):
+                          fault_dropped_step=None, restart_done=None,
+                          spec_strag=None):
         """Assemble the post-superstep state and (optionally) the trace
         row — shared by all routing regimes. ``sent_count`` /
         ``sent_hash`` are computed by the caller (their inputs live at
@@ -1705,6 +1809,23 @@ class JaxEngine(RunStatsMixin, ControlledRunMixin, VerifiedRunMixin,
                  new_st.time, new_st.ev_count),
                 wake, jnp.int64(NEVER), (mb_rel,),
                 st.restart_done, new_st.restart_done, self._faulted)
+        spec = None
+        if self.speculate != "off":
+            # the causality-violation plane (speculate/plane.py):
+            # violations ARE the short_delay step delta — the one
+            # condition the windowed-exactness argument needs — plus
+            # the committed horizon and the earliest offending
+            # delivery time for the pinned diagnostic. Derived only
+            # from values this superstep already computed, so the
+            # emulation is untouched (the speculation off ≡ on
+            # jaxpr/exactness law, tests/test_zzzzzzspec.py)
+            from ...speculate.plane import SpecRow
+            spec = SpecRow(
+                violations=short_step,
+                horizon=t + jnp.asarray(self._w_now, jnp.int64),
+                straggler=(jnp.int64(NEVER) if spec_strag is None
+                           else spec_strag),
+            )
         yrow = _StepOut(
             valid=live, t=t,
             fired_count=comm.all_sum(jnp.sum(fire, dtype=jnp.int32)),
@@ -1715,6 +1836,7 @@ class JaxEngine(RunStatsMixin, ControlledRunMixin, VerifiedRunMixin,
             telem=telem,
             integ=integ,
             rec=rec,
+            spec=spec,
         )
         # mask the trace row too when not live
         yrow = jax.tree.map(
@@ -1901,10 +2023,12 @@ class JaxEngine(RunStatsMixin, ControlledRunMixin, VerifiedRunMixin,
         controller drivers' traced knob operand (controlled.py /
         sweep/runner.py) — passing one requires a bound controller,
         so a stray caller cannot silently run off-spec knob values."""
-        if _dyn is not None and self.controller is None:
+        if _dyn is not None and self.controller is None \
+                and self.speculate == "off":
             raise ValueError(
                 "_dyn carries dispatch-controller knob values; build "
-                "the engine with controller= (docs/dispatch.md)")
+                "the engine with controller= (docs/dispatch.md) or "
+                "speculate= (docs/speculation.md)")
         st = state if state is not None else self.init_state()
         budget, top = self._coerce_budget(max_steps)
         begin = self._stats_begin()
@@ -1918,6 +2042,7 @@ class JaxEngine(RunStatsMixin, ControlledRunMixin, VerifiedRunMixin,
         self._capture_telemetry(ys)
         self._capture_flight(ys, st)
         self._capture_integrity(ys)
+        self._capture_spec(ys)
         if self.batch is not None:
             return final, self._decode_traces(ys)
         m = np.asarray(ys.valid)
@@ -1966,6 +2091,10 @@ class JaxEngine(RunStatsMixin, ControlledRunMixin, VerifiedRunMixin,
             # localization needs run()/run_verified
             from ...integrity.checks import final_state_guard
             final_state_guard(final, type(self).__name__)
+        # never silently mis-speculated: no per-superstep rows here
+        # either, so the violation check degrades to the short_delay
+        # counter delta (speculate/runner.py)
+        self._quiet_spec_guard(st, final)
         return final
 
     def _capture_telemetry(self, ys) -> None:

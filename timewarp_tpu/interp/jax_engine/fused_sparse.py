@@ -332,7 +332,7 @@ class FusedSparseEngine(JaxEngine):
             ok_s = sd < n
             src_s = smrank_s // jnp.int32(M)
             tmsg_s = t + woff_s.astype(jnp.int64)
-            flight_s, _, _, _ = self._sample_nodrop(
+            flight_s, _, _, _, _ = self._sample_nodrop(
                 src_s, sd, tmsg_s, smrank_s % jnp.int32(M), woff_s,
                 ok_s)
             dt_abs = tmsg_s + flight_s

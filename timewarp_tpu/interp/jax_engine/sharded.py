@@ -228,15 +228,19 @@ class ShardedBatchedEngine(ShardedDriver, JaxEngine):
                  lint: str = "warn", faults=None,
                  telemetry: str = "off", controller=None,
                  verify: str = "off", record: str = "off",
-                 record_cap=None) -> None:
+                 record_cap=None, speculate: str = "off") -> None:
         # the flight recorder works here: worlds are whole per device
         # (comm stays LocalComm), and the per-world [T, B_local, R]
         # event planes gather over the world axis like any trace leaf
+        # — and so does the speculation plane (speculate/): worlds
+        # are device-local, so the violation decode sees the gathered
+        # [T, B] columns exactly like the single-chip fleet's
         super().__init__(scenario, link, seed=seed, window=window,
                          route_cap=route_cap, lint=lint, batch=batch,
                          faults=faults, telemetry=telemetry,
                          controller=controller, verify=verify,
-                         record=record, record_cap=record_cap)
+                         record=record, record_cap=record_cap,
+                         speculate=speculate)
         if batch is None:
             raise ValueError(
                 "ShardedBatchedEngine shards the world axis; it needs "

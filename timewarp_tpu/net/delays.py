@@ -31,8 +31,8 @@ from ..core.rng import bernoulli, normal_f32, split_bits, uniform_int
 
 __all__ = [
     "LinkModel", "FixedDelay", "UniformDelay", "LogNormalDelay",
-    "WithDrop", "FnDelay", "Quantize", "SeededHashUniform",
-    "NEVER_CONNECTED",
+    "ParetoDelay", "WithDrop", "FnDelay", "Quantize",
+    "SeededHashUniform", "NEVER_CONNECTED",
 ]
 
 #: Drop probability 1 — ≙ the old API's ``NeverConnected`` outcome.
@@ -137,6 +137,65 @@ class LogNormalDelay(LinkModel):
         d = jnp.asarray(self.median_us, jnp.float32) * jnp.exp(
             jnp.float32(self.sigma) * z)
         d = jnp.clip(d, jnp.float32(self.floor_us), jnp.float32(self.cap_us))
+        return jnp.asarray(jnp.round(d), jnp.int64), \
+            jnp.zeros(jnp.shape(dst), bool)
+
+    @property
+    def min_delay_us(self) -> int:
+        return max(int(self.floor_us), 1)
+
+    @property
+    def can_drop(self) -> bool:
+        return False
+
+
+@dataclass(frozen=True)
+class ParetoDelay(LinkModel):
+    """Pareto (heavy upper tail) latency — the long-tail link of the
+    optimistic-execution win gate (``speculate=``, docs/speculation.md):
+    delay = round(xm · U^(-1/alpha)) clamped to [floor, cap] µs, so
+    samples are supported on [xm_us, cap_us] with the classic
+    power-law tail P(delay > x) = (xm/x)^alpha.
+
+    ``min_delay_us`` declares ``floor_us`` (default 1), **not** xm:
+    the clamp floor is the only bound the model *promises*, and the
+    gap between the provable floor and the practical minimum xm is
+    deliberate — it is exactly the long-median/short-provable-floor
+    regime where a conservative window serializes supersteps at
+    ``floor_us`` while no sample ever lands below xm. Optimistic
+    execution (``speculate=``) closes that gap at run time: the
+    speculative window ladders up toward xm with zero violations and
+    only rolls back when it probes past the distribution's real
+    support. Declaring xm instead would be legal but would also
+    license a *static* window=xm, making the config useless as a
+    speculation benchmark — use an explicit ``floor_us=xm_us`` when a
+    provable xm floor is what you want.
+
+    Float32 internally (the ``U^(-1/alpha)`` power), quantized to µs —
+    the same CPU-validated / cross-backend-caveat regime as
+    :class:`LogNormalDelay`."""
+    xm_us: int
+    alpha: float
+    cap_us: int = 60_000_000
+    floor_us: int = 1
+
+    def sample(self, src, dst, t, key):
+        b0, _ = key
+        # 24-bit mantissa uniform in (0, 1) — never 0, so the power
+        # cannot overflow (the cap clamp below bounds it anyway).
+        # Every field access is tracer-safe jnp arithmetic: the sweep
+        # service vmaps these fields per world (sweep/spec.py
+        # _SWEEPABLE), so they may arrive as batch tracers
+        u = (b0 >> jnp.uint32(8)).astype(jnp.float32) \
+            * jnp.float32(2 ** -24) + jnp.float32(2 ** -25)
+        d = jnp.asarray(self.xm_us, jnp.float32) * jnp.exp(
+            (jnp.float32(-1.0)
+             / jnp.asarray(self.alpha, jnp.float32)) * jnp.log(u))
+        d = jnp.clip(
+            d,
+            jnp.maximum(jnp.asarray(self.floor_us, jnp.float32),
+                        jnp.float32(1.0)),
+            jnp.asarray(self.cap_us, jnp.float32))
         return jnp.asarray(jnp.round(d), jnp.int64), \
             jnp.zeros(jnp.shape(dst), bool)
 

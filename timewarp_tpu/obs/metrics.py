@@ -23,6 +23,10 @@ Kinds:
 - ``integrity`` — one state-integrity verification event per checked
   chunk (integrity/, docs/integrity.md): the verify mode, the chunk,
   and whether the chunk verified or rolled back.
+- ``speculation`` — one optimistic-execution outcome per chunk
+  (speculate/, docs/speculation.md): the speculative window the
+  chunk ran with, and whether it committed or rolled back (rollback
+  lines carry the violation scalars — superstep/horizon/straggler).
 - ``event`` — a point event (OOM split, terminal failure,
   integrity violation, …).
 
@@ -51,9 +55,10 @@ __all__ = ["METRICS_SCHEMA", "MetricsRegistry", "validate_line",
 #: inventory grows: v2 added the dispatch-controller `decision`
 #: kind, v3 the state-integrity `integrity` kind, v4 the flight-
 #: recorder event form — `event` lines with name="flight" carry the
-#: per-message provenance fields below — a v1 reader would mis-skip
-#: lines it cannot interpret)
-METRICS_SCHEMA = 4
+#: per-message provenance fields below — v5 the optimistic-execution
+#: `speculation` kind — a v1 reader would mis-skip lines it cannot
+#: interpret)
+METRICS_SCHEMA = 5
 
 _NUM = (int, float)
 #: kind -> {required field: type tuple}; extra fields are allowed
@@ -80,6 +85,13 @@ _KINDS: Dict[str, Dict[str, tuple]] = {
     # detected and the run restored its last verified snapshot)
     "integrity": {"label": (str,), "mode": (str,), "chunk": (int,),
                   "event": (str,)},
+    # one optimistic-execution outcome per chunk (speculate/,
+    # docs/speculation.md): outcome is "committed" (the chunk's
+    # causality plane decoded clean) or "rollback" (a straggler
+    # violated the committed horizon; the run restored its snapshot
+    # and re-ran at the conservative floor)
+    "speculation": {"label": (str,), "chunk": (int,),
+                    "window_us": (int,), "outcome": (str,)},
     "event": {"name": (str,)},
 }
 

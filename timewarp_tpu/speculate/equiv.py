@@ -1,0 +1,136 @@
+"""The speculation equivalence law's compare surface, made executable.
+
+A committed speculative run and the conservative run of the same
+config are **event-identical**: same firings at the same instants,
+same messages with the same sampled delays and payloads, same final
+scenario state. What legitimately differs is superstep *granularity*
+— a wide window coalesces many conservative supersteps into one — so
+``steps``/``time`` bookkeeping and the per-ROW trace shapes cannot be
+compared literally. This module defines the canonical
+granularity-invariant surface both runs must match **bit-for-bit**:
+
+- the scenario-visible final state: every ``states`` leaf and
+  ``wake``, hashed (sha256 over dtype/shape-framed bytes);
+- every never-silent counter (overflow, bad_dst, bad_delay,
+  short_delay, route_drop, fault_dropped) and ``delivered``;
+- the trace aggregates: total fired/recv/sent counts and the uint32
+  **sums** of the fired/recv/sent row hashes. The row hashes are
+  themselves wrap-around uint32 sums of per-event ``mix32`` words
+  keyed by absolute times (trace/hashing.py), so a wide superstep's
+  row hash IS the sum of the conservative rows it coalesces — the
+  aggregate is granularity-invariant by construction, and any
+  event-level divergence (a reordered delivery, a different sampled
+  delay, a changed payload) moves it.
+
+The surface is defined at quiescence (both runs drained): a
+budget-truncated speculative run has advanced *further in virtual
+time* at the same superstep count, so mid-flight mailboxes
+legitimately differ — the law's callers (tests, the bench gate, the
+CI ``cmp`` leg) run to quiescence and the delivered totals double as
+the completion check. docs/speculation.md states the law in full.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import List, Optional
+
+import numpy as np
+
+__all__ = ["canonical_rows", "write_canon_csv", "assert_spec_equiv",
+           "CANON_FIELDS"]
+
+#: the compare surface, in file-column order
+CANON_FIELDS = ("fired", "fired_hash", "recv", "recv_hash", "sent",
+                "sent_hash", "overflow_rows", "delivered", "overflow",
+                "bad_dst", "bad_delay", "short_delay", "route_drop",
+                "fault_dropped", "state_sha")
+
+_COUNTERS = ("delivered", "overflow", "bad_dst", "bad_delay",
+             "short_delay", "route_drop", "fault_dropped")
+
+
+def _state_sha(state, b: Optional[int]) -> str:
+    """sha256 over the scenario-visible state: every ``states`` leaf
+    plus ``wake``, dtype/shape-framed so layout ambiguity cannot
+    collide two different states."""
+    import jax
+    h = hashlib.sha256()
+    leaves = [state.states[k] for k in sorted(state.states)] \
+        if isinstance(state.states, dict) \
+        else jax.tree.util.tree_leaves(state.states)
+    for leaf in leaves + [state.wake]:
+        a = np.asarray(jax.device_get(leaf))
+        if b is not None:
+            a = a[b]
+        h.update(str(a.dtype).encode())
+        h.update(str(a.shape).encode())
+        h.update(np.ascontiguousarray(a).tobytes())
+    return h.hexdigest()
+
+
+def canonical_rows(state, trace, B: Optional[int] = None
+                   ) -> List[dict]:
+    """One canonical-surface dict per world from a run's final state
+    + trace (``B=None``: solo — ``trace`` is one SuperstepTrace;
+    else ``trace`` is the per-world list every batched driver
+    returns)."""
+    import jax
+    traces = [trace] if B is None else list(trace)
+    out = []
+    for b, tr in enumerate(traces):
+        wb = None if B is None else b
+        agg = {"fired": 0, "fired_hash": 0, "recv": 0, "recv_hash": 0,
+               "sent": 0, "sent_hash": 0, "overflow_rows": 0}
+        for i in range(len(tr)):
+            _, fired, fh, recv, rh, sent, sh, ovf = tr.row(i)
+            agg["fired"] += int(fired)
+            agg["recv"] += int(recv)
+            agg["sent"] += int(sent)
+            agg["overflow_rows"] += int(ovf)
+            agg["fired_hash"] = (agg["fired_hash"] + int(fh)) \
+                & 0xFFFFFFFF
+            agg["recv_hash"] = (agg["recv_hash"] + int(rh)) \
+                & 0xFFFFFFFF
+            agg["sent_hash"] = (agg["sent_hash"] + int(sh)) \
+                & 0xFFFFFFFF
+        row = {"world": b, **agg}
+        for c in _COUNTERS:
+            v = np.asarray(jax.device_get(getattr(state, c)))
+            row[c] = int(v if wb is None else v[wb])
+        row["state_sha"] = _state_sha(state, wb)
+        out.append(row)
+    return out
+
+
+def write_canon_csv(path: str, rows: List[dict]) -> str:
+    """The canonical surface as a byte-deterministic CSV — what the
+    CI speculation-smoke leg ``cmp``s between the conservative and
+    the speculative run of one config."""
+    import csv
+    with open(path, "w", newline="") as f:
+        w = csv.writer(f)
+        w.writerow(("world",) + CANON_FIELDS)
+        for r in rows:
+            w.writerow([r["world"]] + [r[k] for k in CANON_FIELDS])
+    return path
+
+
+def assert_spec_equiv(a: List[dict], b: List[dict],
+                      tag: str = "") -> None:
+    """Bit-for-bit equality on the canonical surface — the
+    speculation equivalence law as one reusable assertion (tests, the
+    in-bench gate). Raises naming the first differing world + field
+    with both scalar values, one line, never an array dump."""
+    suffix = f" ({tag})" if tag else ""
+    if len(a) != len(b):
+        raise AssertionError(
+            f"speculation equivalence law{suffix}: {len(a)} worlds "
+            f"vs {len(b)}")
+    for ra, rb in zip(a, b):
+        for k in CANON_FIELDS:
+            if ra[k] != rb[k]:
+                raise AssertionError(
+                    f"speculation equivalence law{suffix}: world "
+                    f"{ra['world']} field {k!r} diverged — "
+                    f"{ra[k]!r} != {rb[k]!r}")

@@ -73,6 +73,14 @@ class Bucket:
     def controller(self) -> bool:
         return self.configs[0].controller == "auto"
 
+    @property
+    def speculate(self) -> str:
+        """The bucket's optimistic-execution mode (all members agree
+        — part of the bucket key): the whole fleet speculates one
+        window sequence, and ANY world's violation rolls the chunk
+        back for every world (speculate/, docs/speculation.md)."""
+        return self.configs[0].speculate
+
     def split(self) -> Tuple["Bucket", "Bucket"]:
         """Halve the bucket (the OOM degradation path, service.py):
         two children over the same window, ids suffixed so resume can
@@ -93,9 +101,12 @@ def _bucket_key(cfg: RunConfig):
     # controller is part of the bucket's identity: the dispatch
     # controller makes ONE decision sequence per bucket (journaled;
     # replayed by every member's solo twin), so controller-on and
-    # controller-off worlds can never share an executable's chunking
+    # controller-off worlds can never share an executable's chunking.
+    # speculate likewise: the speculation policy is a per-bucket
+    # decision source with per-bucket rollbacks (speculate/), so
+    # worlds under different speculate modes can never share one
     return (cfg.family, cfg.params, link_signature(cfg.parse_link()),
-            resolve_window(cfg), cfg.controller)
+            resolve_window(cfg), cfg.controller, cfg.speculate)
 
 
 def plan_buckets(configs, max_bucket: int = 64) -> List[Bucket]:
@@ -165,6 +176,7 @@ def build_bucket_engine(bucket: Bucket, *, lint: str = "warn",
     eng = JaxEngine(sc, links[0], window=bucket.window, batch=spec,
                     faults=fleet, lint=lint, telemetry=telemetry,
                     controller=controller, verify=verify,
-                    record=record, record_cap=record_cap)
+                    record=record, record_cap=record_cap,
+                    speculate=bucket.speculate)
     eng.metrics_label = f"bucket:{bucket.bucket_id}"
     return eng

@@ -160,11 +160,15 @@ def _finish(svc: SweepService, verify: bool) -> int:
         scan = svc.journal.scan()
         for rid, res in sorted(report.done.items()):
             cfg = svc.pack.by_id(rid)
-            # controller worlds: the solo twin replays the bucket's
-            # journaled decision chain (the replay law carries the
-            # survival law — docs/dispatch.md)
+            # controller AND speculate worlds: the solo twin replays
+            # the bucket's journaled decision chain (the replay law
+            # carries the survival law — docs/dispatch.md; for
+            # speculation the chain is the committed window sequence,
+            # rollbacks already resolved to their floor decisions —
+            # docs/speculation.md)
             decs = svc.decisions_for_world(rid, scan) \
-                if cfg.controller == "auto" else None
+                if cfg.controller == "auto" or cfg.speculate != "off" \
+                else None
             want, solo_tr = solo_result(cfg, lint="off",
                                         decisions=decs,
                                         with_trace=True)
@@ -210,10 +214,35 @@ def _run(argv) -> int:
         description="Run (or resume, on an existing journal) a pack.")
     p.add_argument("pack", help="pack file: JSON list (or JSONL) of "
                    "run configs — see docs/sweeps.md")
+    p.add_argument("--speculate", default=None,
+                   help="optimistic time-warp execution per bucket "
+                        "(speculate/, docs/speculation.md): "
+                        "auto | fixed:W — applied as the pack-level "
+                        "default to every config that does not set "
+                        "its own \"speculate\" (explicit per-config "
+                        "values — including \"off\" — win; this flag "
+                        "beats a pack-file-level \"speculate\" key; "
+                        "the journaled pack carries the result, so "
+                        "resume needs no flag). Committed window "
+                        "choices journal as dispatch_decision events "
+                        "and --verify replays them; rollbacks "
+                        "surface in `sweep status` spec_rollbacks")
     _service_args(p)
     args = p.parse_args(argv)
-    svc = _loud(lambda: SweepService(SweepPack.load(args.pack),
-                                     args.journal, **_kw(args)))
+
+    def build():
+        if args.speculate:
+            from ..speculate import parse_speculate
+            parse_speculate(args.speculate, who="--speculate")
+        # the default applies at the JSON layer (explicit per-config
+        # values — including an explicit "off" opt-out — win) and
+        # BEFORE the pack is journaled, so pack.sha / resume / bucket
+        # planning all see the speculated configs exactly as if the
+        # pack file said it
+        pack = SweepPack.load(args.pack,
+                              speculate_default=args.speculate)
+        return SweepService(pack, args.journal, **_kw(args))
+    svc = _loud(build)
     return _finish(svc, args.verify)
 
 
@@ -254,6 +283,10 @@ def _status(argv) -> int:
         # detected-and-rolled-back state corruptions (integrity/):
         # a nonzero count on real hardware means an SDC-prone host
         "integrity_violations": scan.integrity,
+        # detected-and-rolled-back causality violations (speculate/):
+        # the misspeculation ledger — each one a speculative window
+        # probe the policy backed off from (docs/speculation.md)
+        "spec_rollbacks": scan.spec_rollbacks,
         # per-world flight-recorder event counts (obs/flight.py) —
         # present when the sweep ran with --record; the events
         # themselves live in <journal>/events.jsonl (query with

@@ -67,6 +67,12 @@ class JournalState:
     #: each one a detected state corruption that was rolled back —
     #: surfaced in `sweep status` so an SDC-prone host is visible
     integrity: List[dict] = field(default_factory=list)
+    #: spec_rollback events (speculate/, docs/speculation.md): each
+    #: one a causality violation a speculative chunk detected and
+    #: rolled back — surfaced in `sweep status` so the
+    #: misspeculation rate is visible (observability only; resume
+    #: re-derives rollbacks from the committed decision chain)
+    spec_rollbacks: List[dict] = field(default_factory=list)
     #: run_id -> flight-recorder event count (flight_counts records,
     #: sweep/runner.py; summed across processes — a resumed sweep
     #: journals its own drain). Surfaced in `sweep status` next to
@@ -229,6 +235,9 @@ class SweepJournal:
                 st.retries += 1
             elif ev == "integrity_violation":
                 st.integrity.append(
+                    {k: v for k, v in rec.items() if k != "ev"})
+            elif ev == "spec_rollback":
+                st.spec_rollbacks.append(
                     {k: v for k, v in rec.items() if k != "ev"})
             elif ev == "flight_counts":
                 # per-world recorded-event counts (sweep/runner.py):

@@ -76,6 +76,24 @@ class BucketRunner:
         #: journaled chain, resume/split) instead of re-deciding
         self.ctrl = None
         self.prior_decisions = list(prior_decisions)
+        #: optimistic time-warp execution (speculate/,
+        #: docs/speculation.md): speculate buckets run under a
+        #: SpeculationPolicy — the same decide/replay surface as the
+        #: controller, PLUS rollback. Two discipline differences:
+        #: decisions journal at COMMIT (the policy is a pure function
+        #: of its committed chain — no telemetry to lose in a crash,
+        #: so re-deciding after a kill is bit-deterministic), and a
+        #: SpeculationViolation from the chunk rolls just this chunk
+        #: back (state uncommitted, decision replaced with the floor)
+        #: instead of surfacing to the retry machinery.
+        self._spec = bucket.speculate != "off"
+        #: chunk indices whose decisions are durably journaled — the
+        #: commit-time journaling ledger (prior_decisions arriving
+        #: from a resume scan are journaled by definition; a split
+        #: parent's in-flight unjournaled decision is filtered out in
+        #: split_children)
+        self._journaled = {d["chunk"] if isinstance(d, dict)
+                           else d.chunk for d in self.prior_decisions}
         #: chunks durably executed (checkpoint meta "chunks") — the
         #: next decision's index
         self.chunks = 0
@@ -182,9 +200,25 @@ class BucketRunner:
                     chunk_min=min(8, self.chunk),
                     chunk_max=self.chunk,
                     replay=self.prior_decisions)
+            elif self._spec:
+                from ..speculate import parse_speculate
+                from ..speculate.policy import SpeculationPolicy
+                mode, w = parse_speculate(self.bucket.speculate)
+                # the journaled chain replays as a PREFIX (mode stays
+                # auto/fixed): committed chunks re-apply verbatim,
+                # the in-flight chunk re-decides — identically, the
+                # policy being a pure function of that chain
+                ctrl = SpeculationPolicy(
+                    mode=mode, fixed_w=w, chunk=self.chunk,
+                    replay=self.prior_decisions or None)
             engine = build_bucket_engine(
                 self.bucket, lint=self.lint, telemetry=self.telemetry,
-                controller=ctrl, record=self.record,
+                # a SpeculationPolicy is the runner's host-side
+                # decision source, never an engine binding — the
+                # engine's own speculate= knob (bucket.speculate,
+                # build_bucket_engine) licenses the dynamic window
+                controller=ctrl if self.bucket.controller else None,
+                record=self.record,
                 # digest mode includes the guard rung of the ladder
                 # (the in-scan invariants); the digest itself is this
                 # runner's chunk-boundary business
@@ -357,7 +391,14 @@ class BucketRunner:
                 self._check(epoch)
                 dec, fresh = self.ctrl.decide(
                     ci, eng.last_run_telemetry, t_now)
-                if fresh:
+                if fresh and not self._spec:
+                    # speculate buckets journal at COMMIT instead
+                    # (below): a speculative decision may be replaced
+                    # by its rollback's floor decision before it ever
+                    # commits, and the policy re-derives an in-flight
+                    # decision bit-identically from the journaled
+                    # chain — so journaling early would only plant
+                    # double-journal conflicts
                     self.journal.append(
                         {"ev": "dispatch_decision",
                          "bucket": self.bucket.bucket_id,
@@ -383,9 +424,73 @@ class BucketRunner:
         from ..interp.jax_engine.common import scan_pad
         from ..obs.profiler import annotate
         _t0 = _time.perf_counter()
-        with annotate(f"sweep bucket {self.bucket.bucket_id}"):
-            new_state, traces = eng.run(vec, state=st, **run_kw)
+        # speculate buckets shield the metrics stream while the chunk
+        # runs (the run_verified/run_speculative discipline): the
+        # chunk is uncommitted until its causality plane decodes
+        # clean, and eng.run flushes its `supersteps` lines BEFORE
+        # the decode raises — a violating chunk would leave tainted
+        # (then, after the floor re-run, duplicated) lines behind.
+        # The committed chunk's lines flush below, at commit.
+        if self._spec:
+            eng.metrics = None
+        try:
+            with annotate(f"sweep bucket {self.bucket.bucket_id}"):
+                new_state, traces = eng.run(vec, state=st, **run_kw)
+        except Exception as e:  # noqa: BLE001 — re-raised unless spec
+            from ..speculate import SpeculationViolation
+            if not (self._spec
+                    and isinstance(e, SpeculationViolation)):
+                raise
+            # optimistic rollback (speculate/, docs/speculation.md):
+            # the chunk's causality plane flagged a straggler — the
+            # chunk is DISCARDED (state/digests/trails untouched: the
+            # in-memory view still holds the last committed chunk,
+            # exactly what the checkpoint holds), its decision is
+            # replaced with the conservative floor, and the next
+            # step() call re-runs it. Journaled for observability
+            # (resume needs nothing: the policy re-derives the floor
+            # decision from the committed chain).
+            hit = getattr(e, "hit", None) or {}
+            if dec.window_us <= self.ctrl.floor:
+                # the conservative floor itself violated: the link
+                # model's declared min_delay_us lies about its
+                # samples — surface to the retry machinery (terminal
+                # failure, loud) instead of rolling back forever
+                raise SpeculationViolation(
+                    f"bucket {self.bucket.bucket_id!r} chunk {ci} "
+                    f"violated causality at the conservative floor "
+                    f"{self.ctrl.floor} µs — the link model's "
+                    "declared min_delay_us is not a true lower bound "
+                    "of its samples (docs/speculation.md)", hit) \
+                    from e
+            with self._lock:
+                self._check(epoch)
+                self.ctrl.rollback(ci, hit)
+                eng.last_run_telemetry = None
+                from ..speculate import hit_scalars
+                self.journal.append({
+                    "ev": "spec_rollback",
+                    "bucket": self.bucket.bucket_id, "chunk": ci,
+                    "window_us": dec.window_us, **hit_scalars(hit)})
+                if self.metrics is not None:
+                    self.metrics.emit(
+                        "speculation",
+                        label=f"bucket:{self.bucket.bucket_id}",
+                        chunk=ci, window_us=dec.window_us,
+                        outcome="rollback", **hit_scalars(hit))
+            self.wall_s += _time.perf_counter() - _t0
+            return "running"
+        finally:
+            if self._spec:
+                eng.metrics = self.metrics
         chunk_wall = _time.perf_counter() - _t0
+        if self._spec and self.metrics is not None \
+                and eng.last_run_telemetry is not None:
+            # the committed chunk's telemetry lines — exactly what
+            # eng.run would have flushed had the stream not been
+            # shielded above
+            self.metrics.superstep_chunk(eng.metrics_label,
+                                         eng.last_run_telemetry)
         for b in range(B):
             digests[b] = chain_digest(digests[b], traces[b])
             supersteps[b] += len(traces[b])
@@ -416,6 +521,24 @@ class BucketRunner:
         top = int(vec.max())
         with self._lock:
             self._check(epoch)
+            if self._spec and ci not in self._journaled:
+                # the commit-time half of the speculation journaling
+                # discipline (ctor comment): the decision that
+                # actually committed — floor decisions a rollback
+                # settled on included — becomes durable with its
+                # chunk, so the solo twin's replay chain is exactly
+                # the committed window sequence
+                self.journal.append(
+                    {"ev": "dispatch_decision",
+                     "bucket": self.bucket.bucket_id,
+                     "decision": dec.to_json()})
+                self._journaled.add(ci)
+                if self.metrics is not None:
+                    self.metrics.emit(
+                        "speculation",
+                        label=f"bucket:{self.bucket.bucket_id}",
+                        chunk=ci, window_us=dec.window_us,
+                        outcome="committed")
             self.state = new_state
             self.digests = digests
             self.supersteps = supersteps
@@ -535,6 +658,13 @@ class BucketRunner:
         # decision_chain (journal.py) reassembles the same sequence
         kid_decisions = [d.to_json() for d in self.ctrl.decisions] \
             if self.ctrl is not None else list(self.prior_decisions)
+        if self._spec and self.ctrl is not None:
+            # speculation decisions journal at commit: an in-flight
+            # (unjournaled) decision must not ride to the children as
+            # replay truth — they re-derive it bit-identically from
+            # the committed chain (policy.py module docstring)
+            kid_decisions = [d for d in kid_decisions
+                             if d["chunk"] in self._journaled]
         runners = []
         for child, idxs in parts:
             r = BucketRunner(child, self.journal, self.done,

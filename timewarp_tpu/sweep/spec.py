@@ -82,6 +82,14 @@ class RunConfig:
     #: survival law's solo twin REPLAYS those decisions (the replay
     #: law carries the survival law)
     controller: str = "off"
+    #: optimistic time-warp execution (speculate/,
+    #: docs/speculation.md): "auto" | "fixed:W" runs the world's
+    #: bucket with a speculative window wider than the provable link
+    #: floor, rolling back on causality violations; the committed
+    #: per-chunk window choices are journaled as dispatch_decision
+    #: events and the survival law's solo twin replays them — exactly
+    #: the controller's journaled-decision contract
+    speculate: str = "off"
 
     def __post_init__(self):
         if self.family not in FAMILIES:
@@ -114,6 +122,19 @@ class RunConfig:
                 f"config {self.run_id!r}: controller must be 'off' or "
                 f"'auto', got {self.controller!r} (replay is the "
                 "verify path's business, not a pack knob)")
+        if self.speculate != "off":
+            from ..speculate import parse_speculate
+            try:
+                parse_speculate(self.speculate)
+            except ValueError as e:
+                raise SweepConfigError(
+                    f"config {self.run_id!r}: {e}") from None
+            if self.controller == "auto":
+                raise SweepConfigError(
+                    f"config {self.run_id!r}: speculate and "
+                    "controller are both per-chunk window decision "
+                    "sources — a bucket runs under exactly one "
+                    "(docs/speculation.md)")
 
     # -- JSON (the pack file / journal form) ------------------------------
 
@@ -123,7 +144,7 @@ class RunConfig:
             raise SweepConfigError(
                 f"pack entry {index} must be a JSON object, got {d!r}")
         known = {"id", "scenario", "params", "link", "seed", "window",
-                 "budget", "faults", "controller"}
+                 "budget", "faults", "controller", "speculate"}
         extra = set(d) - known
         if extra:
             raise SweepConfigError(
@@ -150,6 +171,7 @@ class RunConfig:
             budget=intf("budget", 1000),
             faults=d.get("faults"),
             controller=d.get("controller", "off"),
+            speculate=d.get("speculate", "off"),
         )
 
     def to_json(self) -> Dict[str, Any]:
@@ -161,6 +183,8 @@ class RunConfig:
             out["faults"] = self.faults
         if self.controller != "off":
             out["controller"] = self.controller
+        if self.speculate != "off":
+            out["speculate"] = self.speculate
         return out
 
     # -- parsed views ------------------------------------------------------
@@ -168,8 +192,10 @@ class RunConfig:
     def parse_link(self):
         """The config's link model; a malformed spec raises
         :class:`SweepConfigError` naming the run_id (the CLI grammar
-        error is a SystemExit — wrong species for a library path)."""
-        from ..cli import parse_link
+        error is a SystemExit — wrong species for a library path).
+        One grammar serves the CLI and the pack loader (net/links.py),
+        so a pack world and its ``--link`` solo twin cannot drift."""
+        from ..net.links import parse_link
         try:
             return parse_link(self.link)
         except SystemExit as e:
@@ -208,13 +234,22 @@ class SweepPack:
                                    "config")
 
     @classmethod
-    def from_json(cls, data: Any) -> "SweepPack":
+    def from_json(cls, data: Any,
+                  speculate_default: Optional[str] = None
+                  ) -> "SweepPack":
         default_ctrl = None
+        default_spec = speculate_default
         if isinstance(data, dict):
-            # pack-level controller default: {"controller": "auto",
-            # "worlds": [...]} turns the knob on for every config that
-            # does not say otherwise (explicit per-config wins)
+            # pack-level controller/speculate defaults:
+            # {"controller": "auto", "worlds": [...]} turns the knob
+            # on for every config that does not say otherwise
+            # (explicit per-config wins)
             default_ctrl = data.get("controller")
+            # the operator's explicit flag beats the pack-file-level
+            # default (CLI-beats-file, the usual convention); explicit
+            # PER-CONFIG values beat both, below
+            if default_spec is None:
+                default_spec = data.get("speculate")
             data = data.get("worlds", data)
         if not isinstance(data, list):
             raise SweepConfigError(
@@ -224,11 +259,22 @@ class SweepPack:
             data = [({**d, "controller": default_ctrl}
                      if isinstance(d, dict) and "controller" not in d
                      else d) for d in data]
+        if default_spec is not None:
+            data = [({**d, "speculate": default_spec}
+                     if isinstance(d, dict) and "speculate" not in d
+                     else d) for d in data]
         return cls(tuple(RunConfig.from_json(d, i)
                          for i, d in enumerate(data)))
 
     @classmethod
-    def load(cls, path: str) -> "SweepPack":
+    def load(cls, path: str,
+             speculate_default: Optional[str] = None) -> "SweepPack":
+        """Load a pack file. ``speculate_default`` (the CLI's
+        ``sweep run --speculate``) applies at the JSON layer — only
+        to entries with NO ``"speculate"`` key, so a config that
+        explicitly says ``"off"`` keeps its opt-out (an explicit off
+        is indistinguishable from the dataclass default after
+        parsing, which is why this cannot live post-parse)."""
         with open(path) as f:
             text = f.read()
         try:
@@ -242,7 +288,7 @@ class SweepPack:
                 raise SweepConfigError(
                     f"pack file {path!r} is neither a JSON list nor "
                     f"JSONL ({e})") from None
-        return cls.from_json(data)
+        return cls.from_json(data, speculate_default=speculate_default)
 
     def to_json(self) -> List[Dict[str, Any]]:
         return [c.to_json() for c in self.configs]
@@ -297,6 +343,7 @@ _SWEEPABLE = {
     "FixedDelay": ("delay",),
     "UniformDelay": ("lo", "hi"),
     "LogNormalDelay": ("median_us", "sigma", "cap_us", "floor_us"),
+    "ParetoDelay": ("xm_us", "alpha", "cap_us", "floor_us"),
     "Quantize": ("quantum_us",),
 }
 
@@ -344,12 +391,16 @@ def resolve_window(cfg: RunConfig) -> int:
     member's solo twin would. Controller configs resolve the dynamic
     window's BOUND instead — the UNDEGRADED floor, exactly as the
     engine does (degradation clamps on-device per superstep,
-    docs/dispatch.md)."""
+    docs/dispatch.md). Speculate configs resolve their CONSERVATIVE
+    floor the same undegraded way (the speculative bound is derived
+    by the engine from the speculate spec; degradation clamps
+    on-device — docs/speculation.md)."""
     from ..interp.jax_engine.common import I32MAX
     link = cfg.parse_link()
     floor = link.min_delay_us
     sched = cfg.parse_faults()
-    if sched is not None and cfg.controller == "off":
+    if sched is not None and cfg.controller == "off" \
+            and cfg.speculate == "off":
         floor = sched.min_delay_floor(floor)
     if cfg.window == "auto":
         return max(1, min(int(floor), I32MAX - 1))
@@ -380,10 +431,22 @@ def solo_engine(cfg: RunConfig, *, lint: str = "warn",
         from ..dispatch import DispatchController
         controller = DispatchController(mode="replay",
                                         replay=decisions)
+    if cfg.speculate != "off" and decisions is None:
+        # a fresh speculative solo run would roll back on its OWN
+        # violations, not the bucket fleet's (any world's violation
+        # rolls the whole bucket chunk back), so its committed window
+        # sequence — and therefore its superstep granularity — would
+        # legitimately diverge from the streamed result
+        raise SweepConfigError(
+            f"config {cfg.run_id!r} runs under optimistic "
+            "speculation; its solo twin needs the bucket's journaled "
+            "decision records (sweep journal dispatch_decision "
+            "events) to replay the committed window sequence "
+            "(docs/speculation.md)")
     return JaxEngine(sc, cfg.parse_link(), seed=cfg.seed,
                      window=resolve_window(cfg),
                      faults=cfg.parse_faults(), lint=lint,
-                     controller=controller)
+                     controller=controller, speculate=cfg.speculate)
 
 
 #: the digest chain seed (hex of 32 zero bytes)
@@ -447,6 +510,13 @@ def solo_result(cfg: RunConfig, *, lint: str = "warn",
     eng = solo_engine(cfg, lint=lint, decisions=decisions)
     if cfg.controller == "auto":
         final, trace = eng.run_controlled(cfg.budget)
+    elif cfg.speculate != "off":
+        # replay the bucket's committed window sequence — committed
+        # chunks are violation-free by construction, so the replay
+        # never rolls back and is bit-identical to the streamed run
+        # (the speculation replay law, docs/speculation.md)
+        final, trace = eng.run_speculative(cfg.budget,
+                                           replay=decisions)
     else:
         final, trace = eng.run(cfg.budget)
     res = world_result(cfg, final, None,
