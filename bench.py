@@ -9,6 +9,12 @@ frozen-kernel session fingerprint (see ``_calibrate``) so cross-round
 artifacts separate tunnel variance from code changes; the environment
 fields (``_env_fields``, schema-versioned) make CPU-only vs
 chip-attached rounds distinguishable in the artifacts themselves.
+Since BENCH_SCHEMA=2 every line also carries ``config``,
+``config_key`` (the stable cross-run join key: config + requested
+shape + platform), and ``git_sha``; ``--ledger DIR`` auto-appends
+every emitted line to the persistent run ledger
+(timewarp_tpu/obs/ledger.py — `timewarp-tpu ledger compare` is the
+cross-run regression gate over it).
 ``gossip_100k_fused`` additionally runs the telemetry exactness +
 overhead gate (``_telemetry_gate``: counters-mode digests bit-equal
 to off, <= 5% traced-driver cost on chip) and reports
@@ -93,21 +99,63 @@ _SPREAD = {}
 _SMOKE = False
 
 #: BENCH_*.json line schema version: bumped when the line's field
-#: contract changes. v1 adds the environment fields below — the
+#: contract changes. v1 added the environment fields below — the
 #: carried-forward CPU-vs-chip parity debt (ROADMAP) was invisible in
-#: the artifacts themselves until the line said where it ran.
-BENCH_SCHEMA = 1
+#: the artifacts themselves until the line said where it ran. v2 adds
+#: ``config``, ``config_key`` (config name + requested shape +
+#: platform — the stable cross-run join key), and ``git_sha`` (the
+#: producing commit), so the run ledger (timewarp_tpu/obs/ledger.py)
+#: joins trajectories unambiguously; v1 archives remain ingestable
+#: (the ledger derives their key deterministically).
+BENCH_SCHEMA = 2
+
+#: resolved once per process (the sha cannot change mid-bench)
+_GIT_SHA = None
+
+
+def _git_sha():
+    global _GIT_SHA
+    if _GIT_SHA is None:
+        from timewarp_tpu.obs.ledger import resolve_git_sha
+        _GIT_SHA = resolve_git_sha(
+            os.path.dirname(os.path.abspath(__file__)))
+    return _GIT_SHA
+
+
+def _config_key(cfg, n, steps):
+    """The stable cross-run join key (BENCH_SCHEMA v2): config name +
+    the REQUESTED shape (0/None = the config's default — itself a
+    stable identity) + platform. Rates at different shapes or
+    platforms are not comparable, so the key must separate them."""
+    return (f"{cfg}|n{n or 'dflt'}|s{steps or 'dflt'}"
+            f"|{jax.default_backend()}")
 
 
 def _env_fields():
     """Environment provenance on every JSON line: cross-round
     trajectories (BENCH_r*.json) are only interpretable when each
-    line names the platform/device/jax that produced it."""
+    line names the platform/device/jax/commit that produced it."""
     dev = jax.devices()[0]
     return {"schema": BENCH_SCHEMA,
             "platform": jax.default_backend(),
             "device_kind": dev.device_kind,
-            "jax_version": jax.__version__}
+            "jax_version": jax.__version__,
+            "git_sha": _git_sha()}
+
+
+#: (RunLedger, batch_id) when --ledger DIR was passed: every emitted
+#: bench line auto-appends to the cross-run ledger (obs/ledger.py) —
+#: running the bench IS recording it
+_LEDGER = None
+
+
+def _emit(line):
+    """Print one bench JSON line AND (with --ledger) append it to the
+    run ledger under this invocation's shared batch label."""
+    print(json.dumps(line), flush=True)
+    if _LEDGER is not None:
+        _LEDGER[0].add_bench_line(line, batch=_LEDGER[1],
+                                  source="bench.py")
 
 
 def _measure(engine, steps, warm_steps=2):
@@ -354,7 +402,7 @@ def _assert_insert_exact(pallas, ref, gate_steps=12):
 
 def _insert_stage_stats(engine, ref, reps=8):
     """Isolated per-superstep insert-stage timing + achieved-bytes /
-    HBM-roofline fraction for the BENCH_SCHEMA=1 JSON line (ISSUE 8
+    HBM-roofline fraction for the BENCH_SCHEMA JSON line (ISSUE 8
     satellite): a jitted call of each engine's own ``_insert_sorted``
     on one synthetic destination-sorted batch at the pallas stage's
     static width, against this scenario's empty mailbox. Bytes model:
@@ -1367,17 +1415,31 @@ def smoke() -> None:
     on, one JSON line each. Throughput numbers at smoke scale are
     meaningless and marked so — the value of this mode is that a
     kernel-vs-engine divergence or a broken parity-regime invariant
-    raises before a full bench round ever runs."""
+    raises before a full bench round ever runs. TW_BENCH_CONFIG (a
+    comma-separated subset) restricts the sweep — the regression-gate
+    CI job runs a cheap two-config smoke twice into a ledger rather
+    than paying for the full sweep twice."""
     _lint_gate()
     env = _env_fields()
-    for cfg, (n, steps) in SMOKE.items():
+    cfgs = SMOKE
+    only = os.environ.get("TW_BENCH_CONFIG")
+    if only:
+        names = [s.strip() for s in only.split(",") if s.strip()]
+        unknown = sorted(set(names) - set(SMOKE))
+        if unknown:
+            raise SystemExit(
+                f"TW_BENCH_CONFIG names unknown configs {unknown}; "
+                f"choose from {sorted(SMOKE)}")
+        cfgs = {k: SMOKE[k] for k in names}
+    for cfg, (n, steps) in cfgs.items():
         t0 = time.perf_counter()
         metric, _rate, extra = _run_config(cfg, n, steps)
-        print(json.dumps({
-            "config": cfg, "metric": metric, "smoke": True,
+        _emit({
+            "config": cfg, "config_key": _config_key(cfg, n, steps),
+            "metric": metric, "smoke": True,
             "ok": True, "seconds": round(time.perf_counter() - t0, 1),
             **env, **extra,
-        }), flush=True)
+        })
 
 
 def _run_config(cfg, n, steps):
@@ -1391,7 +1453,28 @@ def _run_config(cfg, n, steps):
     return metric, rate, extra
 
 
+def _parse_ledger() -> None:
+    """--ledger DIR: auto-append every emitted line to the cross-run
+    ledger (obs/ledger.py) under one fresh batch label per
+    invocation, so `timewarp-tpu ledger compare` can gate this run
+    against any earlier one."""
+    if "--ledger" not in sys.argv:
+        return
+    try:
+        d = sys.argv[sys.argv.index("--ledger") + 1]
+    except IndexError:
+        raise SystemExit("--ledger takes a ledger directory")
+    if d.startswith("--"):
+        raise SystemExit(f"--ledger takes a ledger directory, "
+                         f"got {d!r}")
+    from timewarp_tpu.obs.ledger import RunLedger
+    global _LEDGER
+    led = RunLedger(d)
+    _LEDGER = (led, led.new_batch())
+
+
 def main() -> None:
+    _parse_ledger()
     if "--smoke" in sys.argv:
         if "--reps" in sys.argv:
             # never-silent knob convention: smoke's value is its gates,
@@ -1422,6 +1505,8 @@ def main() -> None:
     _REPS = reps  # _measure repeats the window; gates/compiles run once
     metric, rate, extra = _run_config(cfg, n, steps)
     out = {
+        "config": cfg,
+        "config_key": _config_key(cfg, n, steps),
         "metric": metric,
         "value": round(rate, 1),  # the median-of-K rate (K = --reps)
         "unit": "msg/s",
@@ -1434,7 +1519,7 @@ def main() -> None:
         out["min"] = round(_SPREAD["min"], 1)
         out["max"] = round(_SPREAD["max"], 1)
     out["calib"] = _calibrate()
-    print(json.dumps(out))
+    _emit(out)
 
 
 if __name__ == "__main__":

@@ -67,9 +67,10 @@ def test_bench_main_prints_one_json_line(capsys, monkeypatch):
     out = capsys.readouterr().out.strip().splitlines()
     assert len(out) == 1
     row = json.loads(out[0])
-    assert set(row) == {"metric", "value", "unit", "vs_baseline",
-                        "schema", "platform", "device_kind",
-                        "jax_version", "calib"}
+    assert set(row) == {"config", "config_key", "metric", "value",
+                        "unit", "vs_baseline", "schema", "platform",
+                        "device_kind", "jax_version", "git_sha",
+                        "calib"}
     assert row["unit"] == "msg/s"
     # environment provenance (ISSUE 7 satellite): the artifact line
     # itself says where it ran, so CPU-only rounds are visible
@@ -77,6 +78,12 @@ def test_bench_main_prints_one_json_line(capsys, monkeypatch):
     assert row["platform"] == "cpu"   # conftest pins the platform
     assert isinstance(row["device_kind"], str) and row["device_kind"]
     assert isinstance(row["jax_version"], str) and row["jax_version"]
+    # cross-run join provenance (BENCH_SCHEMA v2, ISSUE 13): the
+    # stable config_key (name + requested shape + platform) and the
+    # producing commit, so the run ledger joins unambiguously
+    assert row["config"] == "token_ring_dense"
+    assert row["config_key"] == "token_ring_dense|n256|s32|cpu"
+    assert isinstance(row["git_sha"], str) and row["git_sha"]
     # the self-calibration fingerprint: frozen kernel, positive timing
     assert row["calib"]["kernel"] == "sort_1m_int32_x64"
     assert row["calib"]["seconds"] > 0

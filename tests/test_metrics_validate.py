@@ -1,0 +1,174 @@
+"""obs/metrics.py ``validate`` across every schema version it accepts.
+
+The validator is the CI gate for every metrics stream the repo emits
+(telemetry, decisions, integrity, speculation, flight events) — but
+until ISSUE 13 only the LATEST schema was exercised end-to-end. This
+is the v1–v5 corpus: good and bad lines per kind, the empty-file
+refusal, and the file-level error conventions (host-only, no JAX)."""
+
+import json
+
+import pytest
+
+from timewarp_tpu.obs.metrics import (METRICS_SCHEMA, validate_line,
+                                      validate_metrics_file)
+
+# -- the good corpus: one representative line per (schema, kind) ----------
+# kinds by the version that introduced them (metrics.py docstring):
+# v1 supersteps/span/run_summary/utilization/event, v2 decision,
+# v3 integrity, v4 the flight event form, v5 speculation
+
+GOOD = [
+    # v1 kinds — and every later schema must keep accepting them
+    {"schema": 1, "kind": "supersteps", "label": "gossip/general",
+     "supersteps": 12},
+    {"schema": 1, "kind": "span", "name": "checkpoint", "wall_s": 0.2},
+    {"schema": 1, "kind": "run_summary", "label": "ring/edge",
+     "supersteps": 64, "wall_seconds": 1.5, "compiles": 1},
+    {"schema": 1, "kind": "utilization", "bucket": "b0", "worlds": 8,
+     "chunks": 12, "world_supersteps": 5120, "scan_supersteps": 768,
+     "budget_efficiency": 0.83, "pad_waste_frac": 0.06,
+     "worlds_active_mean": 0.91},
+    {"schema": 1, "kind": "event", "name": "oom_split", "bucket": "b1"},
+    # v2: the dispatch-controller decision kind
+    {"schema": 2, "kind": "decision", "chunk": 3, "window_us": 8000,
+     "rung_pin": 2, "chunk_len": 64},
+    # v3: the state-integrity kind (both event values)
+    {"schema": 3, "kind": "integrity", "label": "gossip/general",
+     "mode": "digest", "chunk": 4, "event": "verified"},
+    {"schema": 3, "kind": "integrity", "label": "gossip/general",
+     "mode": "shadow", "chunk": 5, "event": "rollback"},
+    # v4: the flight-recorder event form — name="flight" promises the
+    # full per-message provenance tuple
+    {"schema": 4, "kind": "event", "name": "flight", "ev": "deliver",
+     "superstep": 7, "src": 2, "dst": 3, "send_t_us": 12000,
+     "t_us": 15000},
+    # v5: the optimistic-execution kind (both outcomes; rollback
+    # lines carry extra violation scalars — extras are legal)
+    {"schema": 5, "kind": "speculation", "label": "gossip/general",
+     "chunk": 2, "window_us": 16000, "outcome": "committed"},
+    {"schema": 5, "kind": "speculation", "label": "gossip/general",
+     "chunk": 3, "window_us": 16000, "outcome": "rollback",
+     "violation_superstep": 190, "horizon_us": 21000},
+    # extra fields are forward-compatible on every kind
+    {"schema": 2, "kind": "supersteps", "label": "x", "supersteps": 1,
+     "world": 3, "qslack_us_min": 125},
+]
+
+
+@pytest.mark.parametrize("rec", GOOD,
+                         ids=[f"v{r['schema']}-{r['kind']}"
+                              + (f"-{r.get('name', r.get('outcome', r.get('event', '')) )}"
+                                 if r["kind"] in ("event", "speculation",
+                                                  "integrity") else "")
+                              for r in GOOD])
+def test_good_lines_validate(rec):
+    validate_line(rec)      # must not raise
+
+
+def test_every_schema_version_accepted_up_to_current():
+    for v in range(1, METRICS_SCHEMA + 1):
+        validate_line({"schema": v, "kind": "event", "name": "x"})
+
+
+# -- the bad corpus: every refusal names the offense ----------------------
+
+BAD = [
+    # schema out of range: 0, negative, FUTURE, bool, string
+    ({"schema": 0, "kind": "event", "name": "x"}, "schema"),
+    ({"schema": METRICS_SCHEMA + 1, "kind": "event", "name": "x"},
+     "schema"),
+    ({"schema": True, "kind": "event", "name": "x"}, "schema"),
+    ({"schema": "2", "kind": "event", "name": "x"}, "schema"),
+    ({"kind": "event", "name": "x"}, "schema"),
+    # unknown kind names the known inventory
+    ({"schema": 2, "kind": "nope"}, "unknown metrics kind"),
+    ({"schema": 1}, "unknown metrics kind"),
+    # missing/mistyped required fields, one per kind
+    ({"schema": 1, "kind": "supersteps", "label": "x"}, "supersteps"),
+    ({"schema": 1, "kind": "supersteps", "label": "x",
+      "supersteps": True}, "supersteps"),     # bool is not an int
+    ({"schema": 1, "kind": "supersteps", "label": "x",
+      "supersteps": 1.5}, "supersteps"),
+    ({"schema": 1, "kind": "span", "name": "s"}, "wall_s"),
+    ({"schema": 1, "kind": "span", "wall_s": 0.1}, "name"),
+    ({"schema": 1, "kind": "run_summary", "label": "x",
+      "supersteps": 1, "wall_seconds": 0.1}, "compiles"),
+    ({"schema": 1, "kind": "utilization", "bucket": "b0", "worlds": 8,
+      "chunks": 1, "world_supersteps": 8, "scan_supersteps": 8,
+      "pad_waste_frac": 0.0, "worlds_active_mean": 1.0},
+     "budget_efficiency"),
+    ({"schema": 2, "kind": "decision", "chunk": 0, "window_us": 1000,
+      "chunk_len": 8}, "rung_pin"),
+    ({"schema": 3, "kind": "integrity", "label": "x", "mode": "digest",
+      "chunk": 1}, "event"),
+    ({"schema": 3, "kind": "integrity", "label": "x", "mode": "digest",
+      "chunk": "1", "event": "verified"}, "chunk"),
+    ({"schema": 5, "kind": "speculation", "label": "x", "chunk": 1,
+      "window_us": 500}, "outcome"),
+    ({"schema": 5, "kind": "speculation", "label": "x", "chunk": 1,
+      "window_us": "500", "outcome": "committed"}, "window_us"),
+    # the flight event form: name="flight" demands the provenance
+    # tuple — a half-written event must refuse
+    ({"schema": 4, "kind": "event", "name": "flight", "ev": "deliver",
+      "superstep": 1, "src": 0, "send_t_us": 1, "t_us": 2}, "dst"),
+    ({"schema": 4, "kind": "event", "name": "flight", "ev": "deliver",
+      "superstep": 1, "src": 0, "dst": True, "send_t_us": 1,
+      "t_us": 2}, "dst"),
+    # not an object at all
+    ([1, 2], "JSON object"),
+    ("line", "JSON object"),
+]
+
+
+@pytest.mark.parametrize("rec,msg", BAD,
+                         ids=[f"bad{i}" for i in range(len(BAD))])
+def test_bad_lines_refuse_actionably(rec, msg):
+    with pytest.raises(ValueError, match=msg):
+        validate_line(rec)
+
+
+# -- file-level validation ------------------------------------------------
+
+def _write(tmp_path, name, lines):
+    p = tmp_path / name
+    p.write_text("".join(
+        (json.dumps(ln) if not isinstance(ln, str) else ln) + "\n"
+        for ln in lines))
+    return str(p)
+
+
+def test_file_of_every_schema_version_validates(tmp_path):
+    path = _write(tmp_path, "all.jsonl", GOOD)
+    assert validate_metrics_file(path) == len(GOOD)
+
+
+def test_empty_file_refuses_naming_the_file(tmp_path):
+    p = tmp_path / "empty.jsonl"
+    p.write_text("")
+    with pytest.raises(ValueError,
+                       match="contains no metrics records"):
+        validate_metrics_file(str(p))
+    # whitespace-only is the same refusal (a file of blank lines
+    # validated green would let a dead run pass CI)
+    p.write_text("\n\n  \n")
+    with pytest.raises(ValueError,
+                       match="contains no metrics records"):
+        validate_metrics_file(str(p))
+
+
+def test_file_error_names_file_and_line(tmp_path):
+    path = _write(tmp_path, "bad.jsonl",
+                  [GOOD[0], {"schema": 1, "kind": "span", "name": "s"}])
+    with pytest.raises(ValueError, match=r"bad\.jsonl:2: .*wall_s"):
+        validate_metrics_file(path)
+    path2 = _write(tmp_path, "torn.jsonl", [GOOD[0], '{"schema": 1, '])
+    with pytest.raises(ValueError, match=r"torn\.jsonl:2: not JSON"):
+        validate_metrics_file(path2)
+
+
+def test_blank_lines_are_skipped_not_counted(tmp_path):
+    p = tmp_path / "gaps.jsonl"
+    p.write_text(json.dumps(GOOD[0]) + "\n\n" + json.dumps(GOOD[1])
+                 + "\n")
+    assert validate_metrics_file(str(p)) == 2

@@ -15,9 +15,14 @@ trace; ``--save`` / ``--resume`` checkpoint through
 utils/checkpoint.py.
 
 Subcommands: ``timewarp-tpu lint`` (the scenario sanitizer sweep,
-below), ``timewarp-tpu sweep run|resume|status`` (the fault-tolerant
-sweep service over heterogeneous world packs — sweep/cli.py,
-docs/sweeps.md), ``timewarp-tpu profile FAMILY`` (run a config
+below), ``timewarp-tpu sweep run|resume|status|watch`` (the
+fault-tolerant sweep service over heterogeneous world packs —
+sweep/cli.py, docs/sweeps.md; ``watch`` is the read-only live tail,
+obs/watch.py), ``timewarp-tpu ledger
+add|import|list|show|compare|anomalies`` (the persistent cross-run
+measurement ledger + regression/anomaly analytics — obs/ledger.py,
+obs/regress.py, docs/observability.md "Fleet observability"),
+``timewarp-tpu profile FAMILY`` (run a config
 under full telemetry and emit a ready-to-open Perfetto trace),
 ``timewarp-tpu explain EVENTS.jsonl`` (reconstruct a delivery's
 causal chain from a recorded flight log), and ``timewarp-tpu bisect
@@ -491,9 +496,15 @@ def main(argv=None) -> int:
     if argv and argv[0] == "lint":
         return lint_main(argv[1:])
     if argv and argv[0] == "sweep":
-        # the fault-tolerant sweep service (sweep/): run|resume|status
+        # the fault-tolerant sweep service (sweep/):
+        # run|resume|status|watch
         from .sweep.cli import sweep_main
         return sweep_main(argv[1:])
+    if argv and argv[0] == "ledger":
+        # the persistent cross-run measurement ledger + regression
+        # gates (obs/ledger.py, obs/regress.py)
+        from .obs.ledger import ledger_main
+        return ledger_main(argv[1:])
     if argv and argv[0] == "profile":
         # full-telemetry run + Perfetto trace (docs/observability.md)
         return profile_main(argv[1:])

@@ -48,8 +48,25 @@ Layers:
   chunk, re-run it recorded, and report the first diverging
   superstep, field, and event delta in one pinned line. CLI:
   ``timewarp-tpu bisect``.
+- :mod:`~timewarp_tpu.obs.ledger` — the persistent cross-run
+  measurement ledger: git-sha-stamped, ``config_key``-joined ingest
+  of bench lines, sweep journals, and metrics streams into one
+  append-only index + per-run artifact dirs. CLI: ``timewarp-tpu
+  ledger add|import|list|show|compare|anomalies``; ``bench.py
+  --ledger DIR`` auto-appends every bench line.
+- :mod:`~timewarp_tpu.obs.regress` — noise-aware cross-run
+  regression gates (median-of-reps with min/max spread bands,
+  per-metric relative-change gates) and single-run anomaly
+  detectors (rollback storms, rung thrash, bucket_util collapse,
+  quiescence stragglers), each finding one pinned line.
+- :mod:`~timewarp_tpu.obs.watch` — the live, read-only sweep tail
+  behind ``timewarp-tpu sweep watch``: torn-tail-tolerant
+  incremental readers over the journal + metrics streams, folded
+  through the SAME :class:`~timewarp_tpu.sweep.journal.JournalState`
+  fold as ``sweep status`` (the two surfaces agree by construction).
 
-docs/observability.md is the user-facing guide.
+docs/observability.md is the user-facing guide ("Fleet
+observability" covers the cross-run plane).
 """
 
 from .bisect import (DivergenceReport, bisect_engines, chain_bisect,
@@ -57,14 +74,20 @@ from .bisect import (DivergenceReport, bisect_engines, chain_bisect,
 from .flight import (RECORD_MODES, FlightLog, FlightRecorderMixin,
                      FlightWriter, RecordRow, concat_flight,
                      decode_flight, load_flight_jsonl, validate_record)
+from .ledger import (LEDGER_SCHEMA, LedgerError, RunLedger,
+                     derive_config_key, resolve_git_sha)
 from .metrics import (METRICS_SCHEMA, MetricsRegistry, validate_line,
                       validate_metrics_file)
 from .perfetto import TraceBuilder
 from .profiler import annotate, profile_session
 from .query import (add_flight_flows, chain_lines, explain_delivery,
                     find_deliveries)
+from .regress import (Anomaly, CompareReport, Delta, compare_runs,
+                      compare_selections, detect_anomalies,
+                      detect_target_anomalies)
 from .telemetry import (TELEMETRY_MODES, TelemetryFrames, TelemetryRow,
                         decode_frames, summarize_frames, validate_mode)
+from .watch import SweepWatch, TailReader
 
 __all__ = [
     "TELEMETRY_MODES", "TelemetryRow", "TelemetryFrames",
@@ -79,4 +102,10 @@ __all__ = [
     "add_flight_flows",
     "DivergenceReport", "bisect_engines", "chain_bisect",
     "first_trail_divergence",
+    "LEDGER_SCHEMA", "LedgerError", "RunLedger", "derive_config_key",
+    "resolve_git_sha",
+    "Delta", "Anomaly", "CompareReport", "compare_runs",
+    "compare_selections", "detect_anomalies",
+    "detect_target_anomalies",
+    "SweepWatch", "TailReader",
 ]

@@ -1,4 +1,4 @@
-"""``timewarp-tpu sweep run|resume|status`` — the sweep service CLI.
+"""``timewarp-tpu sweep run|resume|status|watch`` — the sweep CLI.
 
 ::
 
@@ -7,11 +7,17 @@
         [--max-bucket B] [--verify]
     timewarp-tpu sweep resume --journal DIR [...same knobs] [--verify]
     timewarp-tpu sweep status --journal DIR
+    timewarp-tpu sweep watch --journal DIR [--interval S] [--once]
 
 ``run`` on a fresh dir starts the sweep; on an existing dir it
 resumes (same pack only — a different pack is refused loudly).
 ``resume`` needs no pack argument: the journaled copy is the truth.
-``status`` prints one JSON line of progress without running anything.
+``status`` prints one JSON line of progress without running anything;
+its ``events`` block (dispatch decisions, speculation rollbacks,
+integrity violations) comes from the same journal fold the live
+``watch`` renders, so the two surfaces always agree. ``watch``
+attaches a READ-ONLY refreshing tail to a running (or finished)
+sweep — obs/watch.py, docs/observability.md "Fleet observability".
 ``--verify`` re-runs every completed world solo after the sweep and
 asserts the streamed result is bit-identical — the sweep survival law
 as an executable gate (CI runs it).
@@ -264,46 +270,89 @@ def _status(argv) -> int:
     args = p.parse_args(argv)
     j = SweepJournal(args.journal)
     import os
+
+    from .journal import status_fields
     if not os.path.exists(j.pack_path):
         raise SystemExit(
             f"{args.journal!r} holds no sweep (no pack.json)")
     pack = SweepPack.load(j.pack_path)
-    scan = j.scan()
-    total = len(pack.configs)
-    done, failed = len(scan.done), len(scan.failed)
-    print(json.dumps({
-        "worlds": total, "completed": done, "failed": sorted(scan.failed),
-        "pending": total - done - failed, "retries": scan.retries,
-        "splits": {k: v for k, v in scan.splits.items()},
-        "buckets_done": sorted(scan.bucket_done),
-        # per-bucket hardware utilization (sweep/runner.py): how well
-        # the batched executables were used — worlds-active occupancy,
-        # budget-mask efficiency, pow2 scan-pad waste
-        "utilization": scan.util,
-        # detected-and-rolled-back state corruptions (integrity/):
-        # a nonzero count on real hardware means an SDC-prone host
-        "integrity_violations": scan.integrity,
-        # detected-and-rolled-back causality violations (speculate/):
-        # the misspeculation ledger — each one a speculative window
-        # probe the policy backed off from (docs/speculation.md)
-        "spec_rollbacks": scan.spec_rollbacks,
-        # per-world flight-recorder event counts (obs/flight.py) —
-        # present when the sweep ran with --record; the events
-        # themselves live in <journal>/events.jsonl (query with
-        # `timewarp-tpu explain`)
-        "flight_events": scan.flight,
-        "pack_sha": scan.pack_sha}))
+    # ONE shared fold + assembly (journal.py status_fields) behind
+    # both this line and `sweep watch`'s aggregates — the two
+    # surfaces report identical numbers from the same journal by
+    # construction (docs/observability.md "Fleet observability")
+    print(json.dumps(status_fields(j.scan(), len(pack.configs))))
     return 0
 
 
+def _watch(argv) -> int:
+    p = argparse.ArgumentParser(
+        prog="timewarp-tpu sweep watch",
+        description="READ-ONLY live tail of a sweep journal dir "
+                    "(obs/watch.py): refreshing aggregates — worlds "
+                    "done, buckets in flight, retries, event counts, "
+                    "utilization. Plain append-only output (no "
+                    "escape codes); one line per refresh in which "
+                    "anything changed.")
+    p.add_argument("--journal", required=True,
+                   help="the sweep's journal directory")
+    p.add_argument("--interval", type=float, default=2.0,
+                   help="poll interval in seconds (default 2.0)")
+    p.add_argument("--once", action="store_true",
+                   help="render one snapshot and exit 0 — the CI "
+                        "form against a finished journal")
+    p.add_argument("--json", action="store_true",
+                   help="one JSON object per refresh instead of the "
+                        "text line (final snapshot's shared fields "
+                        "equal `sweep status --json`)")
+    p.add_argument("--max-seconds", type=float, default=None,
+                   help="stop watching after this long even if the "
+                        "sweep is still running (default: watch "
+                        "until sweep_done or Ctrl-C)")
+    args = p.parse_args(argv)
+    if args.interval <= 0:
+        raise SystemExit(
+            f"--interval must be > 0, got {args.interval}")
+    import os
+    import time as _time
+
+    from ..obs.watch import SweepWatch
+    if args.once and not os.path.exists(
+            os.path.join(args.journal, "journal.jsonl")):
+        raise SystemExit(
+            f"{args.journal!r} holds no sweep journal to snapshot "
+            "(no journal.jsonl)")
+    w = SweepWatch(args.journal)
+    deadline = None if args.max_seconds is None \
+        else _time.monotonic() + args.max_seconds
+    last = None
+    try:
+        while True:
+            snap = w.poll()
+            out = json.dumps(snap) if args.json else w.render(snap)
+            if out != last:
+                print(out, flush=True)
+                last = out
+            if args.once or w.finished:
+                return 0
+            if deadline is not None and _time.monotonic() >= deadline:
+                return 0
+            _time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0        # watching is observational; detach quietly
+
+
 def sweep_main(argv) -> int:
-    if not argv or argv[0] not in ("run", "resume", "status"):
+    if not argv or argv[0] not in ("run", "resume", "status",
+                                   "watch"):
         raise SystemExit(
             "usage: timewarp-tpu sweep run PACK --journal DIR | "
-            "sweep resume --journal DIR | sweep status --journal DIR")
+            "sweep resume --journal DIR | sweep status --journal DIR"
+            " | sweep watch --journal DIR")
     cmd, rest = argv[0], argv[1:]
     if cmd == "run":
         return _run(rest)
     if cmd == "resume":
         return _resume(rest)
+    if cmd == "watch":
+        return _watch(rest)
     return _status(rest)

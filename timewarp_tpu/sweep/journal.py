@@ -31,7 +31,8 @@ import os
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Set
 
-__all__ = ["SweepJournal", "JournalState", "SweepJournalError"]
+__all__ = ["SweepJournal", "JournalState", "SweepJournalError",
+           "status_fields"]
 
 _log = logging.getLogger("timewarp.sweep")
 
@@ -84,6 +85,99 @@ class JournalState:
     #: obs.bisect.first_trail_divergence to name the first diverging
     #: chunk on a survival-law mismatch
     chains: Dict[str, list] = field(default_factory=dict)
+
+    def apply(self, rec: Dict[str, Any]) -> None:
+        """Fold ONE journal record into this state — the single fold
+        both :meth:`SweepJournal.scan` and the live ``sweep watch``
+        tail (obs/watch.py) run, so a watcher's aggregates and
+        ``sweep status`` can never disagree about the same journal."""
+        self.events.append(rec)
+        ev = rec.get("ev")
+        if ev == "pack":
+            if self.pack_sha is not None and self.pack_sha != rec["sha"]:
+                raise SweepJournalError(
+                    "journal holds events for two different packs — "
+                    "one journal dir per sweep")
+            self.pack_sha = rec["sha"]
+        elif ev == "world_done":
+            rid = rec["result"]["run_id"]
+            if rid in self.done:
+                if self.done[rid] == rec["result"]:
+                    # an interrupted attempt's straggler replayed
+                    # an identical record — harmless, noted
+                    _log.warning("sweep journal: duplicate "
+                                 "world_done for %r (identical "
+                                 "result)", rid)
+                    return
+                raise SweepJournalError(
+                    f"world {rid!r} is double-journaled with "
+                    f"DIFFERENT results — refusing to pick one:\n"
+                    f"  first:  {self.done[rid]}\n"
+                    f"  second: {rec['result']}")
+            self.done[rid] = rec["result"]
+            self.world_bucket[rid] = rec.get("bucket", "")
+            self.chains[rid] = list(rec.get("chain", []))
+        elif ev == "world_failed":
+            self.failed[rec["run_id"]] = rec
+        elif ev == "bucket_done":
+            self.bucket_done.add(rec["bucket"])
+        elif ev == "bucket_split":
+            self.splits[rec["bucket"]] = list(rec["into"])
+        elif ev == "bucket_util":
+            # a resumed bucket re-journals its (process-local)
+            # utilization; last record wins — wall facts are not
+            # replayable, only results are
+            self.util[rec["bucket"]] = {
+                k: v for k, v in rec.items() if k != "ev"}
+        elif ev == "retry":
+            self.retries += 1
+        elif ev == "integrity_violation":
+            self.integrity.append(
+                {k: v for k, v in rec.items() if k != "ev"})
+        elif ev == "spec_rollback":
+            self.spec_rollbacks.append(
+                {k: v for k, v in rec.items() if k != "ev"})
+        elif ev == "flight_counts":
+            # per-world recorded-event counts (sweep/runner.py):
+            # each process journals its own drain once per bucket
+            # run, so summing across records totals the sweep
+            for rid, n in rec.get("counts", {}).items():
+                self.flight[rid] = self.flight.get(rid, 0) + int(n)
+        elif ev == "dispatch_decision":
+            dl = self.decisions.setdefault(rec["bucket"], [])
+            d = rec["decision"]
+            dup = next((p for p in dl
+                        if p["chunk"] == d["chunk"]), None)
+            if dup is not None:
+                knobs = ("window_us", "rung_pin", "chunk_len")
+                if any(dup[k] != d[k] for k in knobs):
+                    # the one unforgivable controller state: two
+                    # different decisions claim the same chunk —
+                    # a replayed resume would match neither run
+                    raise SweepJournalError(
+                        f"bucket {rec['bucket']!r} chunk "
+                        f"{d['chunk']} is double-journaled with "
+                        f"DIFFERENT dispatch decisions — "
+                        f"refusing to pick one:\n  first:  {dup}"
+                        f"\n  second: {d}")
+                _log.warning("sweep journal: duplicate dispatch "
+                             "decision for bucket %r chunk %d "
+                             "(identical knobs)", rec["bucket"],
+                             d["chunk"])
+            else:
+                dl.append(d)
+
+    def event_counts(self) -> Dict[str, int]:
+        """The journal's telemetry-event tallies in one block — the
+        ``events`` field of ``sweep status --json`` AND the live
+        ``sweep watch`` aggregates, computed from the same fold so
+        the two surfaces report identical numbers by construction."""
+        return {
+            "dispatch_decision": sum(len(v)
+                                     for v in self.decisions.values()),
+            "spec_rollback": len(self.spec_rollbacks),
+            "integrity_violation": len(self.integrity),
+        }
 
     def decision_chain(self, bucket_id: str) -> List[dict]:
         """Every decision record governing ``bucket_id``'s worlds, in
@@ -193,79 +287,50 @@ class SweepJournal:
     def scan(self) -> JournalState:
         st = JournalState()
         for rec in self.records():
-            st.events.append(rec)
-            ev = rec.get("ev")
-            if ev == "pack":
-                if st.pack_sha is not None and st.pack_sha != rec["sha"]:
-                    raise SweepJournalError(
-                        f"journal {self.path!r} holds events for two "
-                        "different packs — one journal dir per sweep")
-                st.pack_sha = rec["sha"]
-            elif ev == "world_done":
-                rid = rec["result"]["run_id"]
-                if rid in st.done:
-                    if st.done[rid] == rec["result"]:
-                        # an interrupted attempt's straggler replayed
-                        # an identical record — harmless, noted
-                        _log.warning("sweep journal: duplicate "
-                                     "world_done for %r (identical "
-                                     "result)", rid)
-                        continue
-                    raise SweepJournalError(
-                        f"world {rid!r} is double-journaled with "
-                        f"DIFFERENT results — refusing to pick one:\n"
-                        f"  first:  {st.done[rid]}\n"
-                        f"  second: {rec['result']}")
-                st.done[rid] = rec["result"]
-                st.world_bucket[rid] = rec.get("bucket", "")
-                st.chains[rid] = list(rec.get("chain", []))
-            elif ev == "world_failed":
-                st.failed[rec["run_id"]] = rec
-            elif ev == "bucket_done":
-                st.bucket_done.add(rec["bucket"])
-            elif ev == "bucket_split":
-                st.splits[rec["bucket"]] = list(rec["into"])
-            elif ev == "bucket_util":
-                # a resumed bucket re-journals its (process-local)
-                # utilization; last record wins — wall facts are not
-                # replayable, only results are
-                st.util[rec["bucket"]] = {
-                    k: v for k, v in rec.items() if k != "ev"}
-            elif ev == "retry":
-                st.retries += 1
-            elif ev == "integrity_violation":
-                st.integrity.append(
-                    {k: v for k, v in rec.items() if k != "ev"})
-            elif ev == "spec_rollback":
-                st.spec_rollbacks.append(
-                    {k: v for k, v in rec.items() if k != "ev"})
-            elif ev == "flight_counts":
-                # per-world recorded-event counts (sweep/runner.py):
-                # each process journals its own drain once per bucket
-                # run, so summing across records totals the sweep
-                for rid, n in rec.get("counts", {}).items():
-                    st.flight[rid] = st.flight.get(rid, 0) + int(n)
-            elif ev == "dispatch_decision":
-                dl = st.decisions.setdefault(rec["bucket"], [])
-                d = rec["decision"]
-                dup = next((p for p in dl
-                            if p["chunk"] == d["chunk"]), None)
-                if dup is not None:
-                    knobs = ("window_us", "rung_pin", "chunk_len")
-                    if any(dup[k] != d[k] for k in knobs):
-                        # the one unforgivable controller state: two
-                        # different decisions claim the same chunk —
-                        # a replayed resume would match neither run
-                        raise SweepJournalError(
-                            f"bucket {rec['bucket']!r} chunk "
-                            f"{d['chunk']} is double-journaled with "
-                            f"DIFFERENT dispatch decisions — "
-                            f"refusing to pick one:\n  first:  {dup}"
-                            f"\n  second: {d}")
-                    _log.warning("sweep journal: duplicate dispatch "
-                                 "decision for bucket %r chunk %d "
-                                 "(identical knobs)", rec["bucket"],
-                                 d["chunk"])
-                else:
-                    dl.append(d)
+            try:
+                st.apply(rec)
+            except SweepJournalError as e:
+                # re-raise with the file named (apply is path-free so
+                # the live watch tail can share it verbatim)
+                raise SweepJournalError(
+                    f"sweep journal {self.path!r}: {e}") from None
         return st
+
+
+def status_fields(scan: JournalState,
+                  total_worlds: Optional[int]) -> Dict[str, Any]:
+    """The shared progress block behind ``sweep status --json`` and
+    the final aggregates of ``sweep watch`` (obs/watch.py): ONE
+    assembly over one fold, so the two surfaces are equal by
+    construction. ``total_worlds`` is the pack's world count (None
+    when a watcher attached before ``pack.json`` was written)."""
+    done, failed = len(scan.done), len(scan.failed)
+    return {
+        "worlds": total_worlds, "completed": done,
+        "failed": sorted(scan.failed),
+        "pending": (None if total_worlds is None
+                    else total_worlds - done - failed),
+        "retries": scan.retries,
+        "splits": {k: v for k, v in scan.splits.items()},
+        "buckets_done": sorted(scan.bucket_done),
+        # per-bucket hardware utilization (sweep/runner.py): how well
+        # the batched executables were used — worlds-active occupancy,
+        # budget-mask efficiency, pow2 scan-pad waste
+        "utilization": scan.util,
+        # detected-and-rolled-back state corruptions (integrity/):
+        # a nonzero count on real hardware means an SDC-prone host
+        "integrity_violations": scan.integrity,
+        # detected-and-rolled-back causality violations (speculate/):
+        # the misspeculation ledger — each one a speculative window
+        # probe the policy backed off from (docs/speculation.md)
+        "spec_rollbacks": scan.spec_rollbacks,
+        # the journal's event tallies in one block (event_counts):
+        # dispatch decisions, speculation rollbacks, integrity
+        # violations — the cross-run ledger ingests exactly this
+        "events": scan.event_counts(),
+        # per-world flight-recorder event counts (obs/flight.py) —
+        # present when the sweep ran with --record; the events
+        # themselves live in <journal>/events.jsonl (query with
+        # `timewarp-tpu explain`)
+        "flight_events": scan.flight,
+        "pack_sha": scan.pack_sha}
