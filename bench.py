@@ -1031,6 +1031,75 @@ def bench_sweep_hetero_auto(n, steps):
             delivered / dt, extra)
 
 
+def bench_search_gossip(n, steps):
+    """Adversarial chaos search (timewarp_tpu/search/, docs/
+    search.md): a seeded ChaosSearch campaign over fault-schedule
+    space on burst gossip — generations of candidate schedules
+    evaluated as shape-shared batched fleets, counterfactual forking
+    (suffix continuations from a digest-verified mid-run snapshot),
+    delta-minimization, and the repro artifact. Three gates before
+    the number counts: the campaign must FIND a property violation
+    (eventually-delivered — the rumor can be starved), the minimized
+    repro must re-fail the property on a from-scratch solo
+    evaluation (the replayability gate), and at least one fork must
+    have saved real supersteps (``fork_saving_frac > 0``). Reports
+    world evaluations/sec through the whole campaign (compiles,
+    forks, minimization, and journaling included — this is search
+    throughput, not bare engine throughput)."""
+    import shutil
+    import tempfile
+
+    from timewarp_tpu.search import ChaosSearch
+    from timewarp_tpu.search.objectives import rejudge_repro
+    from timewarp_tpu.sweep.spec import RunConfig
+
+    n = n or 64
+    steps = steps or 300
+    params = {"nodes": n, "fanout": 2, "end_us": 120_000,
+              "burst": True, "think_us": 5000, "mailbox_cap": 16}
+    base = RunConfig(run_id="search-base", family="gossip",
+                     params=tuple(sorted(params.items())),
+                     link="uniform:1000:5000", seed=0, window="auto",
+                     budget=steps)
+    d = tempfile.mkdtemp(prefix="tw_search_bench_")
+    try:
+        t0 = time.perf_counter()
+        campaign = ChaosSearch(base=base,
+                               objective="eventually-delivered",
+                               population=8, generations=6, seed=2,
+                               fork_k=2, journal_dir=d)
+        result = campaign.run()
+        dt = time.perf_counter() - t0
+        assert result.found, (
+            f"the seeded campaign failed to rediscover a violating "
+            f"schedule: {result.to_json()}")
+        assert result.fork["saving_frac"] > 0, (
+            "counterfactual forking never saved a superstep: "
+            f"{result.fork}")
+        # the replayability gate: the emitted repro re-fails the
+        # property on a fresh solo evaluation (the one shared
+        # artifact-replay helper — search/objectives.rejudge_repro)
+        rec = result.repro
+        obj, violated, _ = rejudge_repro(rec)
+        assert violated, (
+            f"minimized repro {rec['faults']!r} does not re-fail "
+            f"{obj.name}")
+        evals = (result.evaluations + result.fork["fork_worlds"]
+                 + result.fork["confirmations"] + 1)
+        extra = {"evaluations": evals,
+                 "generations": len(result.generations),
+                 "found": True,
+                 "fork_saving_frac": result.fork["saving_frac"],
+                 "forks": result.fork["forks"],
+                 "minimized": result.minimized,
+                 "minimized_events": rec["events"]}
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+    return (f"adversarial chaos search (campaign + counterfactual "
+            f"fork + minimize + repro re-fail gate) world "
+            f"evaluations/sec @{n} nodes", evals / dt, extra)
+
+
 def bench_praos_1m_b4(n, steps):
     """Praos as a 4-world fleet sweeping BOTH seed and link model per
     world (lognormal median 18/20/22/24 ms — a Monte-Carlo link study
@@ -1341,6 +1410,7 @@ CONFIGS = {
     "praos_1m_b4": bench_praos_1m_b4,
     "sweep_hetero": bench_sweep_hetero,
     "sweep_hetero_auto": bench_sweep_hetero_auto,
+    "search_gossip": bench_search_gossip,
 }
 
 #: --smoke shapes: every config tiny enough for a CPU CI runner, all
@@ -1366,6 +1436,7 @@ SMOKE = {
     "praos_1m_b4": (1024, 24),
     "sweep_hetero": (256, 96),
     "sweep_hetero_auto": (256, 96),
+    "search_gossip": (64, 300),
 }
 
 

@@ -25,11 +25,13 @@ def test_bench_config_runs(cfg):
          "praos_1m": 512, "praos_1m_fused": 2048,
          "praos_1m_insert": 2048,
          "praos_1m_b4": 512, "sweep_hetero": 256,
-         "sweep_hetero_auto": 256}[cfg]
+         "sweep_hetero_auto": 256, "search_gossip": 64}[cfg]
     # the gossip waves run to quiescence and assert they got there;
-    # the sweep-service configs take per-world budgets, not a window
+    # the sweep-service configs take per-world budgets, not a window;
+    # the search config's steps are a per-evaluation budget
     steps = 20_000 if cfg.startswith("gossip_100k") else \
-        96 if cfg.startswith("sweep_hetero") else 48
+        96 if cfg.startswith("sweep_hetero") else \
+        300 if cfg == "search_gossip" else 48
     metric, rate, extra = bench._run_config(cfg, n, steps)
     assert rate > 0
     assert str(n) in metric
@@ -48,6 +50,14 @@ def test_bench_config_runs(cfg):
             < extra["supersteps_conservative"]
         assert 0.0 <= extra["rollback_rate"] <= 1.0
         assert extra["rollbacks"] >= 0
+    if cfg == "search_gossip":
+        # the chaos-search config's three in-bench gates already ran
+        # (found + repro re-fail + fork saving); the line must carry
+        # the honest numbers
+        assert extra["found"] is True
+        assert extra["fork_saving_frac"] > 0
+        assert extra["minimized"] and extra["minimized_events"] >= 1
+        assert extra["evaluations"] > 0
     if cfg == "gossip_100k_record":
         # the flight-recorder config reports honest per-mode numbers
         # (obs/flight.py): both modes measured, events recorded, and

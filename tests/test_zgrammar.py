@@ -265,3 +265,69 @@ def test_parse_faults_round_trip_idempotent(spec):
     assert a == b
     ta, tb = a.tables(8), b.tables(8)
     assert all(np.array_equal(x, y) for x, y in zip(ta, tb))
+
+
+# -- format_faults: the grammar round-trip serializer ----------------------
+#
+# The chaos search (timewarp_tpu/search/) emits every minimized
+# counterexample as a paste-able --faults string, which needs a
+# serializer whose re-parse is FIELD-EQUAL to the schedule it
+# printed — pinned over the whole good-spec corpus plus adversarial
+# shapes (non-contiguous node sets, descending ids, float scales).
+
+FORMAT_FAULTS = GOOD_FAULTS + [
+    "degrade:0+5:all:0:100:1.5",          # non-contiguous node set
+    "degrade:7+2:3-5:10:20:2.5:7",        # descending ids + ranges
+    "crash:0:0:1",                        # minimal window
+    "partition:0|1-6+7:0:10",             # singleton group + join
+    "skew:4:-250",                        # negative offset
+]
+
+
+@pytest.mark.parametrize("spec", FORMAT_FAULTS)
+def test_format_faults_round_trips_field_equal(spec):
+    import numpy as np
+
+    from timewarp_tpu.faults.schedule import format_faults
+    a = parse_faults(spec)
+    out = format_faults(a)
+    b = parse_faults(out)
+    assert a.events == b.events, (spec, out)
+    # and the lowered tables agree bit-for-bit
+    ta, tb = a.tables(8), b.tables(8)
+    assert all(np.array_equal(x, y) for x, y in zip(ta, tb))
+    # idempotent: formatting the re-parse prints the same string
+    assert format_faults(b) == out
+
+
+def test_format_faults_numpy_scale_round_trips():
+    """np.float64 IS a float subclass, so LinkWindow accepts it — and
+    its repr ('np.float64(2.0)') must never leak into the grammar
+    string (programmatic scales come from numpy vectors). The
+    constructor normalizes to a plain float."""
+    import numpy as np
+
+    from timewarp_tpu.faults.schedule import (FaultSchedule,
+                                              LinkWindow,
+                                              format_faults)
+    s = FaultSchedule((LinkWindow(None, None, 0, 100,
+                                  scale=np.float64(2.0)),))
+    out = format_faults(s)
+    assert out == "degrade:all:all:0:100:2.0"
+    assert parse_faults(out).events == s.events
+
+
+def test_format_faults_refuses_empty_schedule():
+    from timewarp_tpu.faults.schedule import (FaultSchedule,
+                                              format_faults)
+    with pytest.raises(ValueError, match="empty"):
+        format_faults(FaultSchedule(()))
+
+
+def test_format_faults_ignores_fleet_pad():
+    """pad is a fleet-shape artifact with no grammar form: a padded
+    schedule prints the same events, and the re-parse (pad zero) is
+    result-identical by the inert-row law."""
+    from timewarp_tpu.faults.schedule import format_faults
+    a = parse_faults("crash:3:5s:9s")
+    assert format_faults(a.padded(4, 2, 2)) == format_faults(a)

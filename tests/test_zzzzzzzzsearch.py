@@ -1,0 +1,367 @@
+"""Adversarial chaos search (timewarp_tpu/search/, docs/search.md).
+
+Pins, in order: the batched property helpers (faults/properties.py
+``check_worlds``); the snapshot-fork law — a mid-run per-world
+checkpoint slice loaded into a fresh K-world continuation fleet
+continues world 0 (unchanged suffix) bit-for-bit ≡ the uninterrupted
+run, digest chain included, while divergent suffixes actually bite;
+fork-suffix validation (no rewriting the snapshot's past); the
+deterministic minimizer; the campaign determinism law — one campaign
+is a pure function of (config, knobs, seed): identical generation
+history, identical counterexample, identical minimized repro string,
+and the repro re-fails the property solo; and the ledger's ``search``
+ingest kind.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from timewarp_tpu.faults.properties import (check_worlds,
+                                            prop_converged,
+                                            prop_eventually_delivered)
+from timewarp_tpu.faults.schedule import (FaultSchedule, LinkWindow,
+                                          NodeCrash, parse_faults)
+from timewarp_tpu.search import (ChaosSearch, fork_bucket,
+                                 load_fork_state,
+                                 minimize_counterexample, run_fork)
+from timewarp_tpu.search.domain import ScheduleDomain, candidate_config
+from timewarp_tpu.search.fork import validate_fork_suffix
+from timewarp_tpu.search.objectives import (evaluate_configs,
+                                            parse_objective)
+from timewarp_tpu.sweep.bucket import Bucket, build_bucket_engine
+from timewarp_tpu.sweep.spec import DIGEST_ZERO, RunConfig, chain_digest
+from timewarp_tpu.utils.checkpoint import save_state
+
+
+def _gossip_cfg(run_id="w0", *, nodes=8, end_us=120_000, budget=300,
+                faults=None, seed=0):
+    params = {"nodes": nodes, "fanout": 2, "end_us": end_us,
+              "burst": True, "think_us": 5000, "mailbox_cap": 16}
+    return RunConfig(run_id=run_id, family="gossip",
+                     params=tuple(sorted(params.items())),
+                     link="uniform:1000:5000", seed=seed,
+                     window="auto", budget=budget, faults=faults)
+
+
+def _ring_cfg(run_id="w0", *, budget=60, faults=None):
+    params = {"nodes": 8, "n_tokens": 2, "think_us": 2000,
+              "bootstrap_us": 1000, "end_us": 1 << 40,
+              "mailbox_cap": 8}
+    return RunConfig(run_id=run_id, family="token-ring",
+                     params=tuple(sorted(params.items())),
+                     link="uniform:1000:5000", seed=3,
+                     window="auto", budget=budget, faults=faults)
+
+
+# -- batched property checks (faults/properties.py) ------------------------
+
+def test_check_worlds_slices_the_fleet():
+    base = _gossip_cfg("ok")
+    kill = _gossip_cfg("kill", faults="crash:0:0:240000")
+    evals = evaluate_configs([base, kill], lint="off")
+    traces = [evals["ok"].trace, evals["kill"].trace]
+    scheds = [evals["ok"].schedule, evals["kill"].schedule]
+    res = check_worlds(traces, scheds,
+                       [prop_eventually_delivered(0)],
+                       run_ids=["ok", "kill"])
+    assert list(res.ok) == [True, False]
+    assert not res.all_ok
+    assert len(res.failures) == 1
+    f = res.failures[0]
+    assert (f.world, f.run_id) == (1, "kill")
+    assert f.prop == "eventually-delivered:0"
+    assert "no delivery" in f.detail
+    # converged over a trivially-true predicate holds wherever the
+    # trace is nonempty
+    res2 = check_worlds(traces, None, [prop_converged(lambda r: True)])
+    assert list(res2.ok) == [True, len(traces[1]) > 0]
+
+
+def test_check_worlds_refuses_mismatched_shapes():
+    base = _gossip_cfg("ok")
+    evals = evaluate_configs([base], lint="off")
+    tr = [evals["ok"].trace]
+    with pytest.raises(ValueError, match="world schedules"):
+        check_worlds(tr, [FaultSchedule(()), FaultSchedule(())],
+                     [prop_eventually_delivered(0)])
+    with pytest.raises(ValueError, match="run_ids"):
+        check_worlds(tr, None, [prop_eventually_delivered(0)],
+                     run_ids=["a", "b"])
+
+
+# -- the snapshot-fork law (the ISSUE's fork satellite) --------------------
+
+def test_fork_world0_unchanged_suffix_is_bit_identical(tmp_path):
+    base = _ring_cfg(faults="crash:2:20000:40000")
+    pad = (2, 1, 1)
+
+    # the uninterrupted run: one world, whole budget
+    bucket = Bucket("u", (base,), 1000, fault_pad=pad)
+    eng_u = build_bucket_engine(bucket, lint="off")
+    final_u, traces_u = eng_u.run_stream(bucket.budgets, chunk=64)
+    digest_u = chain_digest(DIGEST_ZERO, traces_u[0])
+
+    # the forked run: 20 supersteps, snapshot, then a K=3 fleet of
+    # continuations from the snapshot — world 0's suffix unchanged
+    eng_p = build_bucket_engine(Bucket("p", (base,), 1000,
+                                       fault_pad=pad), lint="off")
+    st, traces_pre = eng_p.run(np.asarray([20], np.int64),
+                               state=eng_p.init_state())
+    ckpt = str(tmp_path / "snap.npz")
+    save_state(ckpt, st, meta={"t": "fork-test"})
+    t_fork = int(np.asarray(st.time)[0])
+    # suffix windows open past the EXECUTED horizon t_fork + window
+    # (window = 1000 here): the snapshot's last superstep already
+    # fired [t_fork, t_fork + 1000)
+    s1 = FaultSchedule(tuple(base.parse_faults().events)
+                       + (NodeCrash(5, t_fork + 1000,
+                                    t_fork + 60_000),))
+    s2 = FaultSchedule(tuple(base.parse_faults().events)
+                       + (LinkWindow(None, None, t_fork + 2000,
+                                     t_fork + 80_000, 2.0),))
+    base_sched = base.parse_faults()
+    # a WIDER fork pad than the snapshot's own: exercises the
+    # restart_done False-growth in utils/checkpoint.load_world_state
+    fengine, fcfgs = fork_bucket(base, [base_sched, s1, s2], t_fork,
+                                 fault_pad=(3, 1, 1), lint="off")
+    state, t_fork2, _meta = load_fork_state(fengine, ckpt, 0)
+    assert t_fork2 == t_fork
+    fr = run_fork(fengine, state, base.budget, chunk=64)
+    assert fr.prefix_supersteps == 20
+    assert 0.0 < fr.saving_frac < 1.0
+
+    # the fork law: world 0 ≡ the uninterrupted run, digest chain
+    # included (prefix chain continued through the suffix)
+    digest_f = chain_digest(chain_digest(DIGEST_ZERO, traces_pre[0]),
+                            fr.traces[0])
+    assert digest_f == digest_u
+    for fld in ("time", "steps", "delivered", "overflow",
+                "fault_dropped", "short_delay"):
+        assert int(np.asarray(getattr(fr.final, fld))[0]) \
+            == int(np.asarray(getattr(final_u, fld))[0]), fld
+    # and the divergent suffixes actually bit: world 1's appended
+    # crash drops deliveries the unchanged world never loses
+    assert int(np.asarray(fr.final.fault_dropped)[1]) \
+        > int(np.asarray(fr.final.fault_dropped)[0])
+    assert chain_digest(DIGEST_ZERO, fr.traces[1]) \
+        != chain_digest(DIGEST_ZERO, fr.traces[0])
+
+
+def test_fork_suffix_validation():
+    base = parse_faults("crash:2:20000:40000")
+    t_fork, window = 50_000, 1000
+    # prefix must be carried unmodified
+    with pytest.raises(ValueError, match="unmodified prefix"):
+        validate_fork_suffix(base, FaultSchedule(
+            (NodeCrash(3, 60_000, 70_000),)), t_fork, window)
+    # suffix windows must open past the EXECUTED horizon — the
+    # snapshot's last superstep already fired [t_fork, t_fork + W)
+    with pytest.raises(ValueError, match="rewrite the snapshot"):
+        validate_fork_suffix(base, FaultSchedule(
+            tuple(base.events) + (NodeCrash(3, 10_000, 70_000),)),
+            t_fork, window)
+    with pytest.raises(ValueError, match="executed horizon"):
+        validate_fork_suffix(base, FaultSchedule(
+            tuple(base.events)
+            + (NodeCrash(3, t_fork + window - 1, 70_000),)),
+            t_fork, window)
+    # skews shift the view of ALL time — never a valid suffix
+    from timewarp_tpu.faults.schedule import ClockSkew
+    with pytest.raises(ValueError, match="ClockSkew"):
+        validate_fork_suffix(base, FaultSchedule(
+            tuple(base.events) + (ClockSkew(1, 100),)), t_fork,
+            window)
+    # shrink degradations could undercut the resolved window
+    with pytest.raises(ValueError, match="scale < 1"):
+        validate_fork_suffix(base, FaultSchedule(
+            tuple(base.events)
+            + (LinkWindow(None, None, 60_000, 70_000, 0.5),)),
+            t_fork, window)
+    # a legal suffix (opening exactly at the horizon) passes
+    validate_fork_suffix(base, FaultSchedule(
+        tuple(base.events)
+        + (NodeCrash(3, t_fork + window, 70_000),)), t_fork, window)
+
+
+# -- the minimizer ---------------------------------------------------------
+
+def test_minimizer_drops_and_tightens_deterministically():
+    # violation := some crash on node 0 covers [10_000, 11_000)
+    def judge(s):
+        return any(isinstance(e, NodeCrash) and e.node == 0
+                   and e.t_down <= 10_000 and e.t_up >= 11_000
+                   for e in s.events)
+    sched = parse_faults(
+        "degrade:all:all:0:50000:2.0; crash:0:2000:90000; "
+        "partition:0-3|4-7:1000:2000; crash:5:0:80000")
+    base = _gossip_cfg()
+    res = minimize_counterexample(base, sched,
+                                  parse_objective("eventually-delivered"),
+                                  _judge=judge)
+    assert [type(e).__name__ for e in res.schedule.events] \
+        == ["NodeCrash"]
+    e = res.schedule.events[0]
+    # binary search lands on the exact still-violating edges
+    assert (e.node, e.t_down, e.t_up) == (0, 10_000, 11_000)
+    assert res.dropped_events == 3
+    # a non-violating input is refused loudly
+    with pytest.raises(ValueError, match="does not violate"):
+        minimize_counterexample(base, parse_faults("skew:1:5"),
+                                parse_objective("eventually-delivered"),
+                                _judge=lambda s: False)
+
+
+def test_objective_grammar():
+    assert parse_objective("eventually-delivered").after_t == 0
+    assert parse_objective("eventually-delivered:5ms").after_t == 5000
+    assert parse_objective("convergence:2s").limit_us == 2_000_000
+    for bad in ("bogus", "convergence", "eventually-delivered:x:y"):
+        with pytest.raises(SystemExit, match="grammar"):
+            parse_objective(bad)
+
+
+# -- the campaign determinism law ------------------------------------------
+
+def _campaign(jdir):
+    # a near-violation seed schedule: widening the crash past the
+    # deadline starves the rumor — the operators find it in very few
+    # generations, keeping the pin cheap
+    base = _gossip_cfg("search-base", end_us=30_000, budget=120,
+                       faults="crash:0:0:20000")
+    return ChaosSearch(base=base, objective="eventually-delivered",
+                       population=5, generations=4, seed=0,
+                       fork_k=2, minimize_trials=60,
+                       journal_dir=str(jdir) if jdir else None)
+
+
+@pytest.mark.slow
+def test_campaign_determinism_and_repro(tmp_path):
+    r1 = _campaign(tmp_path / "j1").run()
+    assert r1.found, r1
+    assert r1.minimized and r1.repro
+    # the determinism law: identical generation history, identical
+    # counterexample, identical minimized repro string
+    r2 = _campaign(tmp_path / "j2").run()
+    assert r2.generations == r1.generations
+    assert r2.counterexample == r1.counterexample
+    assert r2.minimized == r1.minimized
+    assert r2.repro == r1.repro
+    with open(tmp_path / "j1" / "repro.json") as f:
+        d1 = f.read()
+    with open(tmp_path / "j2" / "repro.json") as f:
+        assert f.read() == d1
+    # the repro re-fails the property solo (bit-for-bit replayability
+    # is the engines' existing determinism — this pins the property)
+    from timewarp_tpu.search.objectives import rejudge_repro
+    _, violated, _ = rejudge_repro(r1.repro)
+    assert violated
+    # the journal ingests into the run ledger as the `search` kind
+    from timewarp_tpu.obs.ledger import RunLedger
+    led = RunLedger(str(tmp_path / "led"))
+    (rid,) = led.add_source(str(tmp_path / "j1"))
+    rec = led.get(rid)
+    assert rec["kind"] == "search"
+    assert rec["search"]["found"] is True
+    assert rec["search"]["minimized"] == r1.minimized
+    assert rec["config_key"].startswith("search|gossip|")
+
+
+def test_campaign_refuses_trivially_violated_objective():
+    base = _gossip_cfg("search-base", end_us=30_000, budget=120)
+    c = ChaosSearch(base=base,
+                    objective="eventually-delivered:29000000",
+                    population=3, generations=1, seed=0)
+    with pytest.raises(ValueError, match="already violates"):
+        c.run()
+
+
+def test_campaign_refuses_reused_journal_dir(tmp_path):
+    # campaigns have no resume: a second campaign must not append
+    # its stream to an existing journal (the ledger ingest would mix
+    # the first campaign's records with the last repro.json)
+    jd = tmp_path / "j"
+    jd.mkdir()
+    (jd / "journal.jsonl").write_text('{"ev": "search_campaign"}\n')
+    base = _gossip_cfg("search-base", end_us=30_000, budget=120)
+    with pytest.raises(ValueError, match="fresh --journal"):
+        ChaosSearch(base=base, objective="eventually-delivered",
+                    population=3, generations=1, seed=0,
+                    journal_dir=str(jd))
+
+
+def test_campaign_guards_elites_below_population():
+    base = _gossip_cfg("search-base", end_us=30_000, budget=120)
+    # population=2 defaults elites to 1 (breeding stays alive)
+    c = ChaosSearch(base=base, objective="eventually-delivered",
+                    population=2, generations=1, seed=0)
+    assert c.elites == 1
+    # an explicit elites >= population is refused loudly
+    with pytest.raises(ValueError, match="no offspring"):
+        ChaosSearch(base=base, objective="eventually-delivered",
+                    population=4, generations=1, seed=0, elites=4)
+
+
+def test_domain_and_candidate_config():
+    base = _gossip_cfg()
+    from timewarp_tpu.search.domain import domain_for
+    dom = domain_for(base)
+    assert (dom.n_nodes, dom.horizon_us) == (8, 120_000)
+    # horizon is part of the campaign identity — never guessed
+    pp = RunConfig(run_id="pp", family="ping-pong", params=(),
+                   budget=10)
+    with pytest.raises(ValueError, match="horizon_us"):
+        domain_for(pp)
+    assert domain_for(pp, horizon_us=1000).n_nodes == 2
+    sched = parse_faults("crash:1:0:5000")
+    cand = candidate_config(base, sched, "c1")
+    assert cand.run_id == "c1"
+    assert cand.parse_faults().events == sched.events
+    # an empty schedule is a faults-free config, not an empty string
+    assert candidate_config(base, FaultSchedule(()), "c2").faults \
+        is None
+
+
+def test_mutation_streams_are_deterministic_and_admissible():
+    from timewarp_tpu.search.campaign import _rng
+    from timewarp_tpu.search.mutate import mutate, suffix_mutate
+    dom = ScheduleDomain(8, 120_000)
+    s = FaultSchedule(())
+    seen = []
+    for i in range(30):
+        s = mutate(_rng(7, "t", i), s, dom)
+        assert dom.admissible(s)
+        seen.append(s)
+    s2 = FaultSchedule(())
+    for i in range(30):
+        s2 = mutate(_rng(7, "t", i), s2, dom)
+    assert s2 == seen[-1]
+    # suffix mutation only appends, and only windows past the
+    # executed horizon (the caller passes t_open = t_fork + window)
+    base = parse_faults("crash:1:0:5000")
+    for i in range(20):
+        out = suffix_mutate(_rng(9, i), base, 60_000, dom)
+        if out is None:
+            continue
+        validate_fork_suffix(base, out, 59_000, 1000)
+
+
+def test_load_world_state_guards(tmp_path):
+    import jax
+    base = _ring_cfg()
+    eng = build_bucket_engine(Bucket("g", (base,), 1000), lint="off")
+    st = eng.init_state()
+    path = str(tmp_path / "s.npz")
+    save_state(path, st, meta={})
+    solo = jax.tree.map(lambda x: x[0], st)
+    from timewarp_tpu.utils.checkpoint import load_world_state
+    out, _ = load_world_state(path, solo, 0)
+    for a, b in zip(jax.tree.leaves(out), jax.tree.leaves(solo)):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+    with pytest.raises(ValueError, match="out of range"):
+        load_world_state(path, solo, 5)
+    # handing the BATCHED state as the template is a shape error,
+    # named — not a silent world-axis reinterpretation
+    with pytest.raises(ValueError, match="world-stacked"):
+        load_world_state(path, st, 0)

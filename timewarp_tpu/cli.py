@@ -505,6 +505,11 @@ def main(argv=None) -> int:
         # gates (obs/ledger.py, obs/regress.py)
         from .obs.ledger import ledger_main
         return ledger_main(argv[1:])
+    if argv and argv[0] == "search":
+        # adversarial chaos search over fault-schedule space
+        # (timewarp_tpu/search/, docs/search.md): run|repro
+        from .search.cli import search_main
+        return search_main(argv[1:])
     if argv and argv[0] == "profile":
         # full-telemetry run + Perfetto trace (docs/observability.md)
         return profile_main(argv[1:])

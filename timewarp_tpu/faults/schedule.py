@@ -54,7 +54,7 @@ from ..core.time import FOREVER
 __all__ = [
     "NodeCrash", "Partition", "LinkWindow", "ClockSkew",
     "FaultSchedule", "FaultFleet", "FaultTables",
-    "parse_faults", "FAULT_GRAMMAR",
+    "parse_faults", "format_faults", "FAULT_GRAMMAR",
 ]
 
 #: ceiling every schedule time must stay under (NEVER arithmetic
@@ -161,6 +161,11 @@ class LinkWindow:
                 and not isinstance(self.scale, bool)) or self.scale <= 0:
             raise ValueError(f"scale must be a number > 0, "
                              f"got {self.scale!r}")
+        # normalize to a plain float: np.float64 IS a float subclass,
+        # but its repr ('np.float64(2.0)') would make format_faults
+        # emit an unparseable grammar string — and numpy is exactly
+        # where programmatic scales come from (link-param vectors)
+        object.__setattr__(self, "scale", float(self.scale))
         # exact rational form: the engines transform integer delays as
         # (d * num) // den, identical on every backend
         fr = Fraction(self.scale).limit_denominator(1 << 20)
@@ -568,6 +573,63 @@ def parse_faults(spec: str) -> FaultSchedule:
         raise SystemExit(
             f"empty fault spec {spec!r}; grammar: {FAULT_GRAMMAR}")
     return FaultSchedule(tuple(events))
+
+
+def _fmt_nodes(nodes: Optional[Tuple[int, ...]]) -> str:
+    """One node set in the grammar's '+'-joined ids/ranges form,
+    preserving the stored order (consecutive ascending runs compress
+    to ranges; re-parsing yields the identical tuple)."""
+    if nodes is None:
+        return "all"
+    parts: List[str] = []
+    i, n = 0, len(nodes)
+    while i < n:
+        j = i
+        while j + 1 < n and nodes[j + 1] == nodes[j] + 1:
+            j += 1
+        if j - i >= 1:
+            parts.append(f"{nodes[i]}-{nodes[j]}")
+        else:
+            parts.append(str(nodes[i]))
+        i = j + 1
+    return "+".join(parts)
+
+
+def _fmt_event(e) -> str:
+    if isinstance(e, NodeCrash):
+        s = f"crash:{e.node}:{e.t_down}:{e.t_up}"
+        return s + ":reset" if e.reset_state else s
+    if isinstance(e, Partition):
+        gs = "|".join(_fmt_nodes(g) for g in e.groups)
+        return f"partition:{gs}:{e.t_start}:{e.t_end}"
+    if isinstance(e, LinkWindow):
+        s = (f"degrade:{_fmt_nodes(e.src)}:{_fmt_nodes(e.dst)}:"
+             f"{e.t_start}:{e.t_end}:{e.scale!r}")
+        return s + f":{e.extra_us}" if e.extra_us else s
+    if isinstance(e, ClockSkew):
+        return f"skew:{e.node}:{e.offset_us}"
+    raise ValueError(f"unknown fault event {e!r}")
+
+
+def format_faults(schedule: FaultSchedule) -> str:
+    """The grammar round-trip inverse of :func:`parse_faults`: a
+    ``;``-separated :data:`FAULT_GRAMMAR` string whose re-parse is
+    field-equal to ``schedule`` (tests/test_zgrammar.py pins the
+    law). Times print as raw µs ints — exact, no suffix rounding.
+    ``pad`` is a fleet-shape artifact with no grammar form and is
+    deliberately not represented (a re-parsed schedule carries pad
+    ``(0, 0, 0)``; padding is inert, so the two are result-identical
+    — :class:`FaultTables`). This is what lets the chaos search
+    (timewarp_tpu/search/) emit every minimized counterexample as a
+    paste-able ``--faults`` repro string. An empty schedule has no
+    grammar form (``parse_faults`` refuses empty specs) and is
+    refused here symmetrically."""
+    if not schedule.events:
+        raise ValueError(
+            "an empty FaultSchedule has no --faults grammar form "
+            "(parse_faults refuses empty specs); represent 'no "
+            "faults' as None, the RunConfig convention")
+    return "; ".join(_fmt_event(e) for e in schedule.events)
 
 
 def as_fleet(faults, B: int) -> FaultFleet:

@@ -291,6 +291,65 @@ class RunLedger:
                 rec[f] = line[f]
         return self._commit(rec)
 
+    def add_search(self, journal_dir: str, *,
+                   batch: Optional[str] = None) -> str:
+        """Ingest a chaos-search campaign journal (timewarp_tpu/
+        search/, docs/search.md) as the ``search`` kind: campaign
+        identity (base config, objective, knobs, seed), per-
+        generation progress, fork savings, and — when found — the
+        counterexample and its minimized repro string, so found
+        violations are queryable history."""
+        from ..sweep.journal import SweepJournal
+        j = SweepJournal(journal_dir)
+        if not j.exists():
+            raise LedgerError(
+                f"{journal_dir!r} holds no campaign journal "
+                "(no journal.jsonl)")
+        recs = j.records()
+        meta = next((r for r in recs
+                     if r.get("ev") == "search_campaign"), None)
+        if meta is None:
+            raise LedgerError(
+                f"{journal_dir!r} holds no search_campaign record — "
+                "not a chaos-search journal (sweep journals ingest "
+                "as the 'sweep' kind)")
+        gens = [r for r in recs if r.get("ev") == "search_gen"]
+        done = next((r for r in recs
+                     if r.get("ev") == "search_done"), None)
+        minimized = next((r for r in recs
+                          if r.get("ev") == "search_minimized"), None)
+        ce = next((r for r in recs
+                   if r.get("ev") == "search_counterexample"), None)
+        forks = [r for r in recs if r.get("ev") == "search_fork"]
+        base = meta.get("base", {})
+        os.makedirs(self.runs_dir, exist_ok=True)
+        rec = {
+            "ledger_schema": LEDGER_SCHEMA,
+            "run_id": self._next_run_id(),
+            "batch": batch or self.new_batch(),
+            "kind": "search",
+            "config_key": (f"search|{base.get('scenario', '?')}"
+                           f"|{_slug(str(meta.get('objective')))}"
+                           f"|s{meta.get('seed')}"),
+            "git_sha": resolve_git_sha(journal_dir),
+            "source": os.path.abspath(journal_dir),
+            "search": {
+                "objective": meta.get("objective"),
+                "base": base,
+                "population": meta.get("population"),
+                "generations_planned": meta.get("generations"),
+                "generations_run": len(gens),
+                "seed": meta.get("seed"),
+                "found": bool(done and done.get("found")),
+                "evaluations": (done or {}).get("evaluations"),
+                "counterexample": (ce or {}).get("faults"),
+                "minimized": (minimized or {}).get("faults"),
+                "fork": (done or {}).get("fork"),
+                "forks": len(forks),
+            },
+        }
+        return self._commit(rec)
+
     def add_sweep(self, journal_dir: str, *,
                   batch: Optional[str] = None) -> str:
         """Ingest a finished (or killed) sweep journal: worlds done/
@@ -371,6 +430,24 @@ class RunLedger:
         (``BENCH_r0N.json``: ``{"parsed": <line>, ...}``), or a file
         of bench JSON lines. Returns the new run_ids."""
         if os.path.isdir(path):
+            # a journal dir is a sweep unless its FIRST record says
+            # it is a chaos-search campaign (search/, docs/search.md)
+            # — sniffed from the first line only, so a large finished
+            # journal is not fully parsed twice
+            first = None
+            jp = os.path.join(path, "journal.jsonl")
+            if os.path.exists(jp):
+                with open(jp) as f:
+                    for line in f:
+                        if line.strip():
+                            try:
+                                first = json.loads(line)
+                            except json.JSONDecodeError:
+                                pass
+                            break
+            if isinstance(first, dict) \
+                    and first.get("ev") == "search_campaign":
+                return [self.add_search(path, batch=batch)]
             return [self.add_sweep(path, batch=batch)]
         with open(path) as f:
             text = f.read()
@@ -427,6 +504,11 @@ def _fmt_run(r: Dict[str, Any]) -> str:
         sw = r.get("sweep", {})
         val = (f"  worlds {sw.get('completed')}/{sw.get('worlds')} "
                f"events {sw.get('events')}")
+    elif r.get("kind") == "search":
+        se = r.get("search", {})
+        val = (f"  FOUND {se.get('minimized')!r}"
+               if se.get("found") else "  no counterexample") + \
+            f" gens {se.get('generations_run')}"
     smoke = " smoke" if r.get("smoke") else ""
     return (f"{r['run_id']}  {r.get('batch', '?'):>10}  "
             f"{r.get('kind', '?'):7s}{smoke}  "
@@ -446,8 +528,9 @@ def _add(argv, prog="ledger add", seed=False) -> int:
                    help="ledger directory (created on first add)")
     p.add_argument("sources", nargs="+",
                    help="bench line file | metrics.jsonl | sweep "
-                        "journal dir" + (" | BENCH_r0N.json artifact"
-                                         if seed else ""))
+                        "journal dir | chaos-search campaign journal "
+                        "dir" + (" | BENCH_r0N.json artifact"
+                                 if seed else ""))
     p.add_argument("--batch", default=None,
                    help="batch label (default: one fresh bNNNN per "
                         "invocation; artifact wrappers default to "

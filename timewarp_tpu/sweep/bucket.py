@@ -34,7 +34,8 @@ import numpy as np
 from .spec import (RunConfig, build_scenario, link_signature,
                    link_sweep_params, resolve_window)
 
-__all__ = ["Bucket", "plan_buckets", "build_bucket_engine"]
+__all__ = ["Bucket", "plan_buckets", "build_bucket_engine",
+           "tile_world_state"]
 
 
 @dataclass(frozen=True)
@@ -180,3 +181,28 @@ def build_bucket_engine(bucket: Bucket, *, lint: str = "warn",
                     speculate=bucket.speculate)
     eng.metrics_label = f"bucket:{bucket.bucket_id}"
     return eng
+
+
+def tile_world_state(engine, solo_state):
+    """Fork-from-snapshot bucket admission (timewarp_tpu/search/fork,
+    docs/search.md): broadcast ONE world's solo-shaped state slice
+    (``utils.checkpoint.load_world_state``) across every world of
+    ``engine``'s batch — the initial state of a counterfactual fork
+    fleet, where K continuation worlds share a snapshot prefix and
+    diverge only through their fault-schedule suffixes. Worlds are
+    independent and the copies are bit-identical, so world b of the
+    fork fleet ≡ a solo continuation of the snapshot under schedule b
+    by the batch exactness law (padding rows inert, identical seeds
+    ⇒ identical entropy streams)."""
+    import jax
+    if engine.batch is None:
+        raise ValueError(
+            "tile_world_state targets a batched engine (the fork "
+            "fleet); a solo continuation just resumes load_state's "
+            "result directly")
+    B = engine.batch.B
+
+    def tile(x):
+        arr = np.asarray(jax.device_get(x))   # one host transfer
+        return np.broadcast_to(arr, (B,) + arr.shape).copy()
+    return jax.tree.map(tile, solo_state)

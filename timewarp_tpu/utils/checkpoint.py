@@ -53,7 +53,8 @@ from typing import Any
 import jax
 import numpy as np
 
-__all__ = ["save_state", "load_state", "atomic_write"]
+__all__ = ["save_state", "load_state", "load_world_state",
+           "atomic_write"]
 
 #: the layout every actionable corrupt-load error names
 _LAYOUT = ("an .npz holding leaf_0..leaf_{n-1} state arrays plus "
@@ -114,12 +115,12 @@ def save_state(path: str, state: Any, *, meta: dict = None) -> None:
     atomic_write(path, lambda f: np.savez(f, **arrays))
 
 
-def load_state(path: str, like: Any, *, expect_meta: dict = None):
-    """Read a state pytree saved by :func:`save_state`. ``like`` is a
-    template pytree with the same structure (e.g. ``engine.init_state()``)
-    — the loaded leaves are checked against its shapes/dtypes, so a
-    checkpoint from a different scenario config fails loudly instead of
-    resuming garbage. Returns ``(state, meta)``."""
+def _read_verified(path: str):
+    """The shared raw read behind :func:`load_state` and
+    :func:`load_world_state`: parse the .npz layout, verify every
+    leaf's recorded sha256 (the at-rest integrity half of the
+    detection law), and return ``(leaves, saved_treedef, meta)`` —
+    all structure/shape policy stays with the caller."""
     try:
         with np.load(path) as z:
             n = int(z["__n__"])
@@ -171,6 +172,17 @@ def load_state(path: str, like: Any, *, expect_meta: dict = None):
                     "the state bytes were corrupted on disk — delete "
                     "the file and resume from an earlier verified "
                     "checkpoint (docs/integrity.md)")
+    return leaves, saved_treedef, meta
+
+
+def load_state(path: str, like: Any, *, expect_meta: dict = None):
+    """Read a state pytree saved by :func:`save_state`. ``like`` is a
+    template pytree with the same structure (e.g. ``engine.init_state()``)
+    — the loaded leaves are checked against its shapes/dtypes, so a
+    checkpoint from a different scenario config fails loudly instead of
+    resuming garbage. Returns ``(state, meta)``."""
+    leaves, saved_treedef, meta = _read_verified(path)
+    n = len(leaves)
     t_leaves, treedef = jax.tree.flatten(like)
     if len(t_leaves) != n:
         raise ValueError(
@@ -202,4 +214,75 @@ def load_state(path: str, like: Any, *, expect_meta: dict = None):
                     f"expected {v!r}")
     state = jax.tree.unflatten(treedef, [jax.numpy.asarray(x)
                                          for x in leaves])
+    return state, meta
+
+
+def load_world_state(path: str, like: Any, world: int):
+    """Read ONE world's slice of a *batched* checkpoint saved by
+    :func:`save_state` — the counterfactual-forking loader
+    (timewarp_tpu/search/fork.py, docs/search.md): snapshot a fleet
+    mid-run, then continue just world ``world`` under K divergent
+    fault suffixes without re-running the shared prefix.
+
+    ``like`` is a SOLO-shaped template (e.g. world 0 of the fork
+    engine's ``init_state()``); every checkpoint leaf must carry the
+    template's shape behind one shared leading world axis. Two
+    sanctioned conversions, both exact: the int32 → int64 widening
+    :func:`load_state` already honors, and **fault-row growth** — a
+    1-D bool leaf (the ``restart_done`` restart-consumption ledger)
+    whose template grew MORE rows than the checkpoint holds pads with
+    False, because a fork suffix may append crash events and new
+    crash rows start with their restart un-consumed by definition
+    (padding rows are inert until their window opens —
+    faults/schedule.py FaultTables). Returns ``(state, meta)``, the
+    state solo-shaped."""
+    leaves, saved_treedef, meta = _read_verified(path)
+    n = len(leaves)
+    t_leaves, treedef = jax.tree.flatten(like)
+    if len(t_leaves) != n:
+        raise ValueError(
+            f"checkpoint has {n} leaves, template has {len(t_leaves)}")
+    if saved_treedef != str(treedef):
+        raise ValueError(
+            f"checkpoint tree structure does not match template:\n"
+            f"  saved:    {saved_treedef}\n  template: {treedef}")
+    if not leaves:
+        raise ValueError(f"checkpoint {path!r} holds no state leaves")
+    B = int(leaves[0].shape[0]) if leaves[0].ndim else 0
+    if B < 1:
+        raise ValueError(
+            f"checkpoint {path!r} is not a batched state (leaf 0 has "
+            f"no leading world axis) — load_world_state slices a "
+            "world axis; solo checkpoints load via load_state")
+    w = int(world)
+    if not 0 <= w < B:
+        raise ValueError(
+            f"world {w} out of range for a {B}-world checkpoint "
+            f"{path!r}")
+    out = []
+    for i, (got, want) in enumerate(zip(leaves, t_leaves)):
+        tw = np.asarray(want)
+        if got.ndim != tw.ndim + 1 or got.shape[0] != B:
+            raise ValueError(
+                f"checkpoint leaf {i}: {got.shape}/{got.dtype} is not "
+                f"a [{B}, ...] world-stacked form of the solo "
+                f"template {tw.shape}/{tw.dtype}")
+        sl = got[w]
+        if sl.shape == tw.shape and sl.dtype == np.int32 \
+                and tw.dtype == np.int64:
+            sl = sl.astype(np.int64)    # the sanctioned widening
+        elif sl.dtype == np.bool_ and tw.dtype == np.bool_ \
+                and sl.ndim == 1 and tw.ndim == 1 \
+                and sl.shape[0] < tw.shape[0]:
+            # fault-row growth (docstring): new rows start un-consumed
+            grown = np.zeros(tw.shape, np.bool_)
+            grown[:sl.shape[0]] = sl
+            sl = grown
+        if sl.shape != tw.shape or sl.dtype != tw.dtype:
+            raise ValueError(
+                f"checkpoint leaf {i} world {w}: {sl.shape}/{sl.dtype}"
+                f" does not match template {tw.shape}/{tw.dtype}")
+        out.append(sl)
+    state = jax.tree.unflatten(treedef, [jax.numpy.asarray(x)
+                                         for x in out])
     return state, meta
