@@ -61,6 +61,12 @@ Configs (select with TW_BENCH_CONFIG, default ``token_ring_dense``):
   failure: aggregate delivered-msg/s THROUGH the service (journal +
   checkpoints included), gated by the sweep survival law (every
   streamed result ≡ its solo run, bit-for-bit).
+- ``serve_gossip`` — emulation as a service (serve/,
+  docs/serving.md): heterogeneous gossip configs admitted into
+  open-bucket reserved slots (half mid-bucket) under a work-stealing
+  curator, reporting served configs/sec and p50/p95
+  submit→world_done latency, gated by the extended survival law
+  (every streamed record ≡ its solo run, bit-for-bit).
 
 Env knobs: TW_BENCH_CONFIG, TW_BENCH_NODES (config-default), and
 TW_BENCH_STEPS (supersteps in the measured window). ``--reps K``
@@ -1390,6 +1396,108 @@ def bench_gossip_100k_record(n, steps):
              "record_events": counts, "record_cap": cap})
 
 
+def bench_serve_gossip(n, steps):
+    """Emulation as a service (serve/, docs/serving.md): a
+    work-stealing curator thread plus an in-process admission book —
+    the serving layer WITHOUT the TCP hop, so the number isolates the
+    machinery (admission journaling, lease renewal, open-bucket
+    engine rebuilds, checkpoints, result streaming) from loopback
+    latency; the CI serve-smoke job measures the wire path. Eight
+    gossip configs (heterogeneous seeds + budgets, one faulted) are
+    submitted against 4-slot open buckets — half up front, half
+    mid-bucket while the first chunks run, so admission-into-reserved-
+    slots is exercised every round. Reports end-to-end served
+    configs/sec (first admit -> last world_done, journal ts) plus
+    admission throughput and p50/p95 submit->world_done latency on
+    the BENCH_SCHEMA=2 line. Gated by the extended survival law
+    before the number counts: every streamed record's result must be
+    bit-identical to the solo run of its config."""
+    import shutil
+    import tempfile
+    import threading
+
+    from timewarp_tpu.serve.curator import ServeCurator
+    from timewarp_tpu.serve.frontend import ServeFrontend
+    from timewarp_tpu.sweep import SweepJournal
+    from timewarp_tpu.sweep.spec import RunConfig, solo_result
+
+    n = n or 4096
+    steps = steps or 2000
+    gossip = {"nodes": n, "fanout": 4, "burst": True,
+              "end_us": 400_000, "mailbox_cap": 16, "think_us": 700}
+    cfgs = []
+    for i in range(8):
+        d = {"id": f"w{i}", "scenario": "gossip", "params": gossip,
+             "link": "quantize:1000:uniform:3000:9000", "seed": i,
+             "budget": steps if i % 2 == 0 else max(steps // 2, 8)}
+        if i == 3:
+            d["faults"] = "crash:1:5ms:40ms:reset"
+        cfgs.append(d)
+    root = tempfile.mkdtemp(prefix="tw_serve_bench_")
+    try:
+        journal = SweepJournal(root, host="bench")
+        front = ServeFrontend(journal, "bench", ("127.0.0.1", 0),
+                              slots=4)
+        cur = ServeCurator(root, "bench", chunk=max(32, steps // 8),
+                           lint="off", lease_ttl_s=60.0,
+                           poll_s=0.02, journal=journal)
+        t0 = time.perf_counter()
+        for d in cfgs[:4]:
+            front.admit(d)
+        admit_half = time.perf_counter()
+        worker = threading.Thread(target=cur.run, daemon=True)
+        worker.start()
+        # mid-bucket admission: the curator is already running the
+        # first chunks when these land in the reserved slots
+        for d in cfgs[4:]:
+            front.admit(d)
+        admit_done = time.perf_counter()
+        journal.append({"ev": "serve_drain", "host": "bench"})
+        worker.join(timeout=600)
+        assert not worker.is_alive(), "serve curator never drained"
+        dt = time.perf_counter() - t0
+        scan = SweepJournal(root).scan()
+        assert sorted(scan.done) == sorted(d["id"] for d in cfgs), \
+            f"unserved worlds: {sorted(scan.done)}"
+        # the extended survival law, world by world (the gate
+        # deliberately costs a second pass — docs/serving.md)
+        for d in cfgs:
+            cfg = RunConfig.from_json(d, 0)
+            want = solo_result(cfg, lint="off")
+            got = scan.done[d["id"]]
+            assert want == got, (
+                f"serve survival law violated for {d['id']}:\n"
+                f"  solo:     {want}\n  streamed: {got}")
+        # submit->world_done latency per world from the journal's own
+        # ts stamps (admit append -> world_done append, one clock)
+        t_admit, t_done = {}, {}
+        for e in scan.events:
+            if e.get("ev") == "admit" \
+                    and e["run_id"] not in t_admit:
+                t_admit[e["run_id"]] = float(e["ts"])
+            elif e.get("ev") == "world_done":
+                t_done[e["result"]["run_id"]] = float(e["ts"])
+        lats = sorted(t_done[r] - t_admit[r] for r in t_done)
+        p50 = lats[len(lats) // 2]
+        p95 = lats[min(len(lats) - 1, int(len(lats) * 0.95))]
+        delivered = sum(r["delivered"] for r in scan.done.values())
+        extra = {
+            "worlds": len(cfgs),
+            "admit_per_s": round(
+                len(cfgs) / max(1e-9, (admit_half - t0)
+                                + (admit_done - admit_half)), 2),
+            "submit_p50_s": round(p50, 4),
+            "submit_p95_s": round(p95, 4),
+            "buckets": len(scan.serve_buckets),
+            "delivered_per_s": round(delivered / dt, 2),
+        }
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+    return (f"emulation service (admission + open buckets + stream + "
+            f"survival law) served configs/sec @{n} nodes",
+            len(cfgs) / dt, extra)
+
+
 CONFIGS = {
     "token_ring_dense": bench_token_ring_dense,
     "token_ring_dense_xla": bench_token_ring_dense_xla,
@@ -1411,6 +1519,7 @@ CONFIGS = {
     "sweep_hetero": bench_sweep_hetero,
     "sweep_hetero_auto": bench_sweep_hetero_auto,
     "search_gossip": bench_search_gossip,
+    "serve_gossip": bench_serve_gossip,
 }
 
 #: --smoke shapes: every config tiny enough for a CPU CI runner, all
@@ -1437,6 +1546,7 @@ SMOKE = {
     "sweep_hetero": (256, 96),
     "sweep_hetero_auto": (256, 96),
     "search_gossip": (64, 300),
+    "serve_gossip": (256, 96),
 }
 
 

@@ -25,13 +25,15 @@ def test_bench_config_runs(cfg):
          "praos_1m": 512, "praos_1m_fused": 2048,
          "praos_1m_insert": 2048,
          "praos_1m_b4": 512, "sweep_hetero": 256,
-         "sweep_hetero_auto": 256, "search_gossip": 64}[cfg]
+         "sweep_hetero_auto": 256, "search_gossip": 64,
+         "serve_gossip": 256}[cfg]
     # the gossip waves run to quiescence and assert they got there;
     # the sweep-service configs take per-world budgets, not a window;
     # the search config's steps are a per-evaluation budget
     steps = 20_000 if cfg.startswith("gossip_100k") else \
         96 if cfg.startswith("sweep_hetero") else \
-        300 if cfg == "search_gossip" else 48
+        300 if cfg == "search_gossip" else \
+        96 if cfg == "serve_gossip" else 48
     metric, rate, extra = bench._run_config(cfg, n, steps)
     assert rate > 0
     assert str(n) in metric
@@ -50,6 +52,15 @@ def test_bench_config_runs(cfg):
             < extra["supersteps_conservative"]
         assert 0.0 <= extra["rollback_rate"] <= 1.0
         assert extra["rollbacks"] >= 0
+    if cfg == "serve_gossip":
+        # the serving-layer config's in-bench extended-survival-law
+        # gate already ran; the line must carry the honest latency
+        # and admission numbers (ISSUE 15 satellite)
+        assert extra["worlds"] == 8
+        assert extra["buckets"] >= 2
+        assert extra["admit_per_s"] > 0
+        assert 0 <= extra["submit_p50_s"] <= extra["submit_p95_s"]
+        assert extra["delivered_per_s"] > 0
     if cfg == "search_gossip":
         # the chaos-search config's three in-bench gates already ran
         # (found + repro re-fail + fork saving); the line must carry

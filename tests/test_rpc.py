@@ -332,3 +332,122 @@ def test_calls_survive_connection_resets():
     server2 = Rpc(Dialog(Transport(net2, host="srv", settings=generous)))
     client2 = Rpc(Dialog(Transport(net2, host="cli", settings=generous)))
     assert run_once(server2, client2) == got
+
+
+# -- service-shaped usage: concurrency + reconnects (ISSUE 15 satellite) --
+
+def _run_concurrent_clients(server, clients, addr, port, runner):
+    """N clients x K in-flight calls each, against one server — the
+    serving layer's load shape (serve/frontend.py). Returns
+    {(client, k): total}."""
+    results = {}
+
+    def main() -> Program:
+        from timewarp_tpu.core.effects import fork_
+        from timewarp_tpu.manage.sync import Flag
+        stop = yield from server.serve(port, [_add_method()])
+        flags = []
+        # fork K calls per client, all in flight at once
+        progs = []
+        for ci, client in enumerate(clients):
+            for k in range(4):
+                f = Flag()
+                flags.append(f)
+
+                def mk(ci=ci, client=client, k=k, f=f):
+                    def prog() -> Program:
+                        r = yield from client.call(
+                            addr, Add(100 * ci, k))
+                        results[(ci, k)] = r.total
+                        yield from f.set()
+                    return prog
+                progs.append(mk())
+        for prog in progs:
+            yield from fork_(prog)
+        for f in flags:
+            yield from f.wait()
+        for client in clients:
+            yield from client.dialog.transport.close(addr)
+        yield from stop()
+        return results
+
+    return runner(main)
+
+
+def test_serve_concurrent_clients_emulated():
+    """Three clients, four in-flight calls each, one server — every
+    call resolves to its own caller (call-id routing under real
+    concurrency) on the deterministic emulated interpreter."""
+    net = EmulatedBackend(FixedDelay(1000))
+    server = Rpc(Dialog(Transport(net)))
+    clients = [Rpc(Dialog(Transport(net, host=f"c{i}")))
+               for i in range(3)]
+    got = _run_concurrent_clients(server, clients,
+                                  ("127.0.0.1", 5300), 5300,
+                                  run_emulation)
+    assert got == {(ci, k): 100 * ci + k
+                   for ci in range(3) for k in range(4)}
+
+
+def test_serve_concurrent_clients_real_tcp():
+    """The same shape over real loopback TCP (the fabric
+    `timewarp-tpu serve` actually listens on)."""
+    import os
+    port = 24000 + os.getpid() % 20000
+    net = AioBackend()
+    server = Rpc(Dialog(Transport(net)))
+    clients = [Rpc(Dialog(Transport(AioBackend()))) for _ in range(3)]
+    got = _run_concurrent_clients(server, clients,
+                                  ("127.0.0.1", port), port,
+                                  run_real_time)
+    assert got == {(ci, k): 100 * ci + k
+                   for ci in range(3) for k in range(4)}
+
+
+def _run_reconnect_sequence(server, client, addr, port, runner):
+    """Calls keep completing across a deliberately dropped (closed)
+    and re-created connection — the transport re-dials and the rpc
+    layer re-attaches its response listener (the lively-socket
+    promise long-lived service clients ride). ``transport.close`` is
+    ASYNCHRONOUS (the dying worker pops the pool entry in its own
+    finally), so a call racing the teardown can land on the dying
+    frame and lose its send — exactly the documented at-least-once
+    contract (rpc.py ``call``): callers compose timeout + retry, as
+    the `timewarp-tpu submit` client does."""
+    def call_retry(req) -> Program:
+        for _ in range(20):
+            try:
+                return (yield from timeout(
+                    250_000, lambda: client.call(addr, req)))
+            except TimeoutExpired:
+                continue
+        raise AssertionError("call never completed within 20 retries")
+
+    def main() -> Program:
+        stop = yield from server.serve(port, [_add_method()])
+        r1 = yield from call_retry(Add(1, 1))
+        # drop the pooled connection between calls: the next call
+        # must transparently reconnect and re-attach the listener
+        yield from client.dialog.transport.close(addr)
+        r2 = yield from call_retry(Add(2, 2))
+        yield from client.dialog.transport.close(addr)
+        r3 = yield from call_retry(Add(3, 3))
+        yield from client.dialog.transport.close(addr)
+        yield from stop()
+        return r1.total, r2.total, r3.total
+
+    assert runner(main) == (2, 4, 6)
+
+
+def test_serve_reconnect_emulated():
+    server, client, addr = _rpc_pair()
+    _run_reconnect_sequence(server, client, addr, 5100, run_emulation)
+
+
+def test_serve_reconnect_real_tcp():
+    import os
+    port = 25000 + os.getpid() % 20000
+    server = Rpc(Dialog(Transport(AioBackend())))
+    client = Rpc(Dialog(Transport(AioBackend())))
+    _run_reconnect_sequence(server, client, ("127.0.0.1", port),
+                            port, run_real_time)

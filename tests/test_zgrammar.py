@@ -331,3 +331,88 @@ def test_format_faults_ignores_fleet_pad():
     from timewarp_tpu.faults.schedule import format_faults
     a = parse_faults("crash:3:5s:9s")
     assert format_faults(a.padded(4, 2, 2)) == format_faults(a)
+
+
+# ---------------------------------------------------------------------------
+# the --hosts/--listen host-spec grammar (serve/, ISSUE 15 satellite)
+# ---------------------------------------------------------------------------
+
+BAD_HOSTS = [
+    "",                          # empty spec
+    " ",                         # whitespace spec
+    ",",                         # only separator
+    "a,",                        # trailing empty entry
+    ",b",                        # leading empty entry
+    "a,,b",                      # empty middle entry
+    "a,a",                       # duplicate host name
+    "a,b,a",                     # duplicate host name (non-adjacent)
+    "bad name",                  # space in NAME
+    "a@",                        # '@' without HOST:PORT
+    "a@hostonly",                # missing port
+    "a@:7000",                   # empty host
+    "a@h:",                      # empty port
+    "a@h:x",                     # non-integer port
+    "a@h:0",                     # port below range
+    "a@h:65536",                 # port above range
+    "a@h:70:9",                  # host containing ':' (excess field)
+    "a@@h:7000",                 # double '@'
+    "café",                 # non-ASCII name
+]
+
+BAD_LISTENS = [
+    "",                          # empty spec
+    "host",                      # missing port
+    ":7000",                     # empty host
+    "h:",                        # empty port
+    "h:x",                       # non-integer port
+    "h:0",                       # port below range
+    "h:65536",                   # port above range
+    "h h:7000",                  # space in host (untrimmed)
+    "a@h:7000",                  # '@' belongs to --hosts, not --listen
+    "h,i:7000",                  # ',' in host
+]
+
+
+@pytest.mark.parametrize("spec", BAD_HOSTS)
+def test_malformed_host_specs_name_the_grammar(spec):
+    from timewarp_tpu.serve.hosts import HOST_GRAMMAR, parse_hosts
+    with pytest.raises(SystemExit) as ei:
+        parse_hosts(spec)
+    msg = str(ei.value)
+    assert "grammar" in msg and HOST_GRAMMAR in msg, \
+        f"{spec!r} died without naming HOST_GRAMMAR: {msg}"
+
+
+@pytest.mark.parametrize("spec", BAD_HOSTS)
+def test_malformed_host_specs_never_raw_traceback(spec):
+    from timewarp_tpu.serve.hosts import parse_hosts
+    try:
+        parse_hosts(spec)
+    except SystemExit:
+        pass
+    else:
+        pytest.fail(f"{spec!r} parsed without error")
+
+
+@pytest.mark.parametrize("spec", BAD_LISTENS)
+def test_malformed_listen_specs_name_the_grammar(spec):
+    from timewarp_tpu.serve.hosts import HOST_GRAMMAR, parse_listen
+    with pytest.raises(SystemExit) as ei:
+        parse_listen(spec)
+    msg = str(ei.value)
+    assert "grammar" in msg and HOST_GRAMMAR in msg, \
+        f"{spec!r} died without naming HOST_GRAMMAR: {msg}"
+
+
+def test_good_host_specs_parse():
+    from timewarp_tpu.serve.hosts import (HostSpec, parse_host,
+                                          parse_hosts, parse_listen)
+    assert parse_listen("127.0.0.1:7700") == ("127.0.0.1", 7700)
+    assert parse_listen("my-box.local:1") == ("my-box.local", 1)
+    assert parse_host("alpha") == HostSpec("alpha")
+    assert parse_host("a@10.0.0.1:7700") == \
+        HostSpec("a", ("10.0.0.1", 7700))
+    fleet = parse_hosts("a@10.0.0.1:7700,b,c.2_x")
+    assert [h.name for h in fleet] == ["a", "b", "c.2_x"]
+    assert fleet[0].addr == ("10.0.0.1", 7700)
+    assert fleet[1].addr is None

@@ -1,0 +1,425 @@
+"""Emulation as a service (timewarp_tpu/serve/, docs/serving.md) —
+the extended survival law and the lease protocol, pinned.
+
+The law: every result streamed by the serving layer — over the wire
+or into the shared journal — is bit-identical to the solo run of its
+config, across two-host leases, work-stealing after a curator kill,
+mid-bucket admission of a late-submitted config, re-packing, and
+kill→resume. And lease reclaim never double-runs a bucket: exactly
+one ``world_done`` per run_id, pinned on the merged journal.
+
+Named with nine z's to sort after the whole suite (the 870 s tier-1
+window truncates; new tests must not displace existing dots).
+"""
+
+import json
+import threading
+import time
+
+import pytest
+
+from timewarp_tpu.serve.curator import CuratorKilled, ServeCurator
+from timewarp_tpu.serve.frontend import ServeFrontend, bucket_key_sha
+from timewarp_tpu.serve.lease import LeaseDir, LeaseLost
+from timewarp_tpu.serve.worker import OpenBucketRunner
+from timewarp_tpu.sweep.journal import SweepJournal, status_fields
+from timewarp_tpu.sweep.spec import (RunConfig, SweepPack,
+                                     resolve_window, solo_result)
+
+RING = {"nodes": 64, "n_tokens": 4, "think_us": 2000,
+        "end_us": 1 << 40, "mailbox_cap": 8}
+
+
+def _cfg(i, seed, budget, faults=None, link="uniform:1000:5000"):
+    d = {"id": f"w{i}", "scenario": "token-ring", "params": RING,
+         "link": link, "seed": seed, "budget": budget}
+    if faults:
+        d["faults"] = faults
+    return d
+
+
+def _open_bucket(journal, cfg0, bid="sb0", capacity=4):
+    journal.append({"ev": "bucket_open", "bucket": bid,
+                    "key": bucket_key_sha(cfg0), "capacity": capacity,
+                    "window": resolve_window(cfg0)})
+
+
+def _admit(journal, bid, slot, cfg):
+    journal.append({"ev": "admit", "run_id": cfg.run_id,
+                    "bucket": bid, "slot": slot,
+                    "config": cfg.to_json()})
+
+
+def _world_done_ids(scan):
+    return sorted(e["result"]["run_id"] for e in scan.events
+                  if e.get("ev") == "world_done")
+
+
+# -- the lease protocol ----------------------------------------------------
+
+def test_lease_acquire_peer_blocked_release(tmp_path):
+    a = LeaseDir(str(tmp_path), "a", ttl_s=30.0)
+    b = LeaseDir(str(tmp_path), "b", ttl_s=30.0)
+    la = a.try_acquire("b0")
+    assert la is not None and la.gen == 1 and la.stolen_from is None
+    assert b.try_acquire("b0") is None     # fresh peer lease blocks
+    a.renew(la)                            # heartbeat keeps it ours
+    a.release(la)
+    lb = b.try_acquire("b0")
+    assert lb is not None and lb.stolen_from is None
+
+
+def test_lease_stale_reclaim_and_loser_abandons(tmp_path):
+    a = LeaseDir(str(tmp_path), "a", ttl_s=0.2)
+    b = LeaseDir(str(tmp_path), "b", ttl_s=0.2)
+    la = a.try_acquire("b0")
+    time.sleep(0.3)                        # a "dies": no renewals
+    lb = b.try_acquire("b0")
+    assert lb is not None and lb.stolen_from == "a" \
+        and lb.gen == la.gen + 1
+    # the old holder's every lease operation now refuses
+    with pytest.raises(LeaseLost):
+        a.renew(la)
+    with pytest.raises(LeaseLost):
+        a.check(la)
+    a.release(la)                          # refuses silently: not ours
+    b.check(lb)                            # the thief's stays valid
+
+
+def test_lease_self_reacquire_bumps_generation(tmp_path):
+    """A crashed host's NEW incarnation re-acquires its own lease
+    immediately (no TTL wait) at the next generation — kill→resume
+    under one host name."""
+    a1 = LeaseDir(str(tmp_path), "a", ttl_s=60.0)
+    la1 = a1.try_acquire("b0")
+    a2 = LeaseDir(str(tmp_path), "a", ttl_s=60.0)
+    la2 = a2.try_acquire("b0")
+    assert la2 is not None and la2.gen == la1.gen + 1
+    with pytest.raises(LeaseLost):
+        a1.check(la1)
+
+
+# -- the extended survival law --------------------------------------------
+
+def test_serve_mid_bucket_admission_survival_law(tmp_path):
+    """Drive an open bucket directly: admit one config, run chunks,
+    admit a second (faulted — the fault pad grows mid-bucket) into a
+    reserved slot, run to idle. Both streamed results ≡ solo,
+    bit-for-bit — reserved slots really do hold pristine solo starts
+    and pad growth really is inert."""
+    journal = SweepJournal(str(tmp_path), host="solo")
+    done = {}
+    c0 = RunConfig.from_json(_cfg(0, 0, 96), 0)
+    c1 = RunConfig.from_json(
+        _cfg(1, 7, 64, faults="crash:3:5ms:40ms:reset"), 0)
+    runner = OpenBucketRunner("sb0", journal, done, capacity=4,
+                              window=resolve_window(c0), chunk=8)
+    runner.admit(0, c0)
+    assert runner.step() == "running"
+    assert runner.step() == "running"      # c0 is mid-flight
+    runner.admit(1, c1)                    # late admission, new pad
+    while runner.step() == "running":
+        pass
+    for cfg in (c0, c1):
+        want = solo_result(cfg, lint="off")
+        assert want == done[cfg.run_id], (
+            f"serve survival law violated for {cfg.run_id}:\n"
+            f"  solo:     {want}\n  streamed: {done[cfg.run_id]}")
+    scan = SweepJournal(str(tmp_path)).scan()
+    assert _world_done_ids(scan) == ["w0", "w1"]
+
+
+def test_serve_steal_after_kill_no_double_run(tmp_path):
+    """Two-host lease law end-to-end: host a dies mid-bucket (lease
+    deliberately unreleased), host b steals after the TTL, finishes
+    from the shared checkpoint — every result ≡ solo, exactly ONE
+    world_done per run_id, and the steal is journaled."""
+    root = str(tmp_path)
+    ja = SweepJournal(root, host="a")
+    c0 = RunConfig.from_json(_cfg(0, 0, 96), 0)
+    c1 = RunConfig.from_json(_cfg(1, 3, 48), 0)
+    _open_bucket(ja, c0)
+    _admit(ja, "sb0", 0, c0)
+    _admit(ja, "sb0", 1, c1)
+    ja.append({"ev": "serve_drain", "host": "a"})
+    cur_a = ServeCurator(root, "a", chunk=8, lease_ttl_s=0.4,
+                         journal=ja, die_after_chunks=2)
+    with pytest.raises(CuratorKilled):
+        cur_a.run(max_seconds=120)
+    ja.close()
+    time.sleep(0.5)                        # a's lease goes stale
+    cur_b = ServeCurator(root, "b", chunk=8, lease_ttl_s=0.4)
+    cur_b.run(max_seconds=180)
+    scan = SweepJournal(root).scan()
+    assert sorted(scan.done) == ["w0", "w1"]
+    for cfg in (c0, c1):
+        assert solo_result(cfg, lint="off") == scan.done[cfg.run_id]
+    assert _world_done_ids(scan) == ["w0", "w1"]   # no duplicates
+    steals = [e for e in scan.events
+              if e.get("ev") == "lease_acquire"
+              and e.get("stolen_from")]
+    assert steals and steals[0]["host"] == "b" \
+        and steals[0]["stolen_from"] == "a"
+    hosts = scan.hosts_block()
+    assert hosts["b"]["stolen"] == 1
+    assert hosts["b"]["stolen_buckets"] == [
+        {"bucket": "sb0", "from": "a"}]
+
+
+def test_serve_kill_resume_same_host(tmp_path):
+    """kill→resume under ONE host identity: the new incarnation
+    re-acquires its own stale lease without waiting out the TTL and
+    continues from the checkpoint — results ≡ solo, no duplicates."""
+    root = str(tmp_path)
+    ja = SweepJournal(root, host="a")
+    c0 = RunConfig.from_json(_cfg(0, 5, 96), 0)
+    _open_bucket(ja, c0, capacity=2)
+    _admit(ja, "sb0", 0, c0)
+    ja.append({"ev": "serve_drain", "host": "a"})
+    with pytest.raises(CuratorKilled):
+        ServeCurator(root, "a", chunk=8, lease_ttl_s=60.0,
+                     journal=ja, die_after_chunks=2).run(
+                         max_seconds=120)
+    ja.close()
+    # resume immediately — no TTL sleep: own-name leases are always
+    # reclaimable (lease.py)
+    ServeCurator(root, "a", chunk=8,
+                 lease_ttl_s=60.0).run(max_seconds=180)
+    scan = SweepJournal(root).scan()
+    assert solo_result(c0, lint="off") == scan.done["w0"]
+    assert _world_done_ids(scan) == ["w0"]
+
+
+def test_serve_repack_merges_under_occupied(tmp_path):
+    """Re-packing: a second same-key open bucket with one active
+    world merges into the first bucket's free slots mid-run — the
+    moved world's state/digest/trail splice over and its result stays
+    ≡ solo; the donor closes with a journaled repack event."""
+    root = str(tmp_path)
+    journal = SweepJournal(root, host="a")
+    done = {}
+    c0 = RunConfig.from_json(_cfg(0, 0, 32), 0)
+    c1 = RunConfig.from_json(_cfg(1, 9, 96), 0)
+    w = resolve_window(c0)
+    r0 = OpenBucketRunner("sb0", journal, done, capacity=4,
+                          window=w, chunk=8)
+    r1 = OpenBucketRunner("sb1", journal, done, capacity=4,
+                          window=w, chunk=8)
+    r0.admit(0, c0)
+    r1.admit(0, c1)
+    assert r0.step() == "running"
+    assert r1.step() == "running"          # both mid-flight
+    while r0.step() == "running":          # sb0's world finishes,
+        pass                               # leaving 4 free slots
+    moved = r0.merge_from(r1)              # the re-packing splice
+    assert moved == ["w1"]
+    journal.append({"ev": "repack", "from": "sb1", "into": "sb0",
+                    "moved": moved, "host": "a"})
+    while r0.step() == "running":          # w1 continues inside sb0
+        pass
+    for cfg in (c0, c1):
+        want = solo_result(cfg, lint="off")
+        assert want == done[cfg.run_id], (
+            f"repack broke the survival law for {cfg.run_id}:\n"
+            f"  solo:     {want}\n  streamed: {done[cfg.run_id]}")
+    scan = SweepJournal(root).scan()
+    assert _world_done_ids(scan) == ["w0", "w1"]
+    assert scan.repacks and scan.repacks[0]["moved"] == ["w1"]
+
+
+def test_multi_host_sweep_kill_steal_verify(tmp_path):
+    """The --hosts sweep path: host a dies to an injected kill while
+    holding its lease; host b (same pack, same journal dir) steals
+    after the TTL and completes — merged journal holds every world
+    exactly once and each ≡ its solo run (incl. a decision-free
+    faulted world)."""
+    from timewarp_tpu.sweep.service import SweepKilled, SweepService
+    root = str(tmp_path)
+    pack = SweepPack.from_json([
+        _cfg(0, 0, 96),
+        _cfg(1, 1, 64, faults="crash:3:5ms:40ms:reset"),
+        _cfg(2, 2, 48, link="uniform:2000:7000"),
+    ])
+    with pytest.raises(SweepKilled):
+        SweepService(pack, root, chunk=16, host="a",
+                     lease_ttl_s=0.4, inject="die:2").run()
+    time.sleep(0.5)
+    svc_b = SweepService(pack, root, chunk=16, host="b",
+                         lease_ttl_s=0.4, peer_poll_us=100_000)
+    report = svc_b.run()
+    assert report.ok, report.to_json()
+    scan = SweepJournal(root).scan()
+    assert sorted(scan.done) == ["w0", "w1", "w2"]
+    for cfg in pack.configs:
+        assert solo_result(cfg, lint="off") == scan.done[cfg.run_id]
+    assert _world_done_ids(scan) == ["w0", "w1", "w2"]
+    steals = [e for e in scan.events
+              if e.get("ev") == "lease_acquire"
+              and e.get("stolen_from")]
+    assert steals, "host b never journaled the steal"
+
+
+def test_hosts_block_watch_equals_status(tmp_path):
+    """The hosts/serve blocks ride the SAME fold + assembly behind
+    `sweep status --json` and the live watch — a watch over the
+    finished multi-host journal reports identical folded fields."""
+    from timewarp_tpu.obs.watch import SweepWatch
+    root = str(tmp_path)
+    ja = SweepJournal(root, host="a")
+    c0 = RunConfig.from_json(_cfg(0, 4, 48), 0)
+    _open_bucket(ja, c0, capacity=2)
+    _admit(ja, "sb0", 0, c0)
+    ja.append({"ev": "serve_drain", "host": "a"})
+    ServeCurator(root, "a", chunk=8, lease_ttl_s=30.0,
+                 journal=ja).run(max_seconds=120)
+    ja.append({"ev": "serve_done", "host": "a", "admitted": 1,
+               "completed": 1})
+    ja.close()
+    scan = SweepJournal(root).scan()
+    want = status_fields(scan, len(scan.admits))
+    assert "hosts" in want and "serve" in want
+    w = SweepWatch(root)
+    snap = w.poll()
+    got = {k: v for k, v in snap.items() if k != "watch"}
+    assert got == want
+    assert w.finished
+    # single-host sweeps stay byte-identical: no hosts/serve keys
+    from timewarp_tpu.sweep.journal import JournalState
+    plain = JournalState()
+    plain.apply({"ev": "pack", "sha": "x", "worlds": 1})
+    assert "hosts" not in status_fields(plain, 1)
+
+
+def test_serve_ledger_ingest_kind(tmp_path):
+    """A service journal dir auto-detects in `ledger add` (first-
+    record sniff on the per-host files) and ingests as the `serve`
+    kind with admission/steal/repack rollups."""
+    from timewarp_tpu.obs.ledger import RunLedger
+    root = str(tmp_path / "svc")
+    ja = SweepJournal(root, host="a")
+    ja.append({"ev": "serve_open", "host": "a",
+               "listen": "127.0.0.1:7700", "slots": 2})
+    c0 = RunConfig.from_json(_cfg(0, 11, 32), 0)
+    _open_bucket(ja, c0, capacity=2)
+    _admit(ja, "sb0", 0, c0)
+    ja.append({"ev": "serve_drain", "host": "a"})
+    ServeCurator(root, "a", chunk=8, lease_ttl_s=30.0,
+                 journal=ja).run(max_seconds=120)
+    ja.close()
+    led = RunLedger(str(tmp_path / "ledger"))
+    rids = led.add_source(root)
+    assert len(rids) == 1
+    rec = led.get(rids[0])
+    assert rec["kind"] == "serve"
+    assert rec["serve"]["admitted"] == 1
+    assert rec["serve"]["completed"] == 1
+    assert rec["serve"]["steals"] == 0
+    assert "a" in rec["serve"]["hosts"]
+    assert rec["config_key"].startswith("serve|a|")
+
+
+def test_serve_tcp_roundtrip_streams_bit_identical(tmp_path):
+    """The wire path in one process: the RPC frontend (real loopback
+    TCP, AioBackend) + an embedded curator thread; a client submits
+    two configs, streams both world_done records back, drains — each
+    streamed result ≡ solo (the CI serve-smoke job repeats this
+    across real processes with a mid-bucket host kill)."""
+    import socket
+
+    from timewarp_tpu.core.effects import Program, fork_, timeout
+    from timewarp_tpu.core.errors import TimeoutExpired
+    from timewarp_tpu.interp.aio.timed import run_real_time
+    from timewarp_tpu.manage.sync import Flag
+    from timewarp_tpu.net.backend import AioBackend
+    from timewarp_tpu.net.dialog import Dialog
+    from timewarp_tpu.net.rpc import Rpc
+    from timewarp_tpu.net.transfer import Transport
+    from timewarp_tpu.serve.frontend import (ServeAwait, ServeDrain,
+                                             ServeSubmit)
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    root = str(tmp_path)
+    journal = SweepJournal(root, host="alpha")
+    front = ServeFrontend(journal, "alpha", ("127.0.0.1", port),
+                          slots=2, poll_us=50_000)
+    cur = ServeCurator(root, "alpha", chunk=16, lease_ttl_s=30.0,
+                       poll_s=0.05, journal=journal)
+    worker = threading.Thread(target=cur.run, daemon=True)
+    worker.start()
+    server = Rpc(Dialog(Transport(AioBackend())))
+    client = Rpc(Dialog(Transport(AioBackend())))
+    addr = ("127.0.0.1", port)
+    configs = [_cfg(0, 0, 64), _cfg(1, 5, 32)]
+    results = {}
+
+    def call_retry(req) -> Program:
+        for _ in range(40):
+            try:
+                return (yield from timeout(
+                    5_000_000, lambda: client.call(addr, req)))
+            except TimeoutExpired:
+                continue
+        raise AssertionError("service never answered")
+
+    def main() -> Program:
+        yield from fork_(lambda: front.program(server))
+        flags = []
+        for d in configs:
+            ack = yield from call_retry(
+                ServeSubmit(json.dumps(d, sort_keys=True)))
+            assert ack.run_id == d["id"]
+            flag = Flag()
+            flags.append(flag)
+
+            def mk(rid=ack.run_id, flag=flag):
+                def prog() -> Program:
+                    r = yield from call_retry(ServeAwait(rid))
+                    results[rid] = json.loads(r.record_json)
+                    yield from flag.set()
+                return prog
+            yield from fork_(mk())
+        for flag in flags:
+            yield from flag.wait()
+        yield from call_retry(ServeDrain())
+        yield from client.dialog.transport.close(addr)
+
+    run_real_time(main)
+    worker.join(timeout=60)
+    assert not worker.is_alive(), "curator never drained"
+    for d in configs:
+        cfg = RunConfig.from_json(d, 0)
+        want = solo_result(cfg, lint="off")
+        assert want == results[d["id"]]["result"], (
+            f"wire survival law violated for {d['id']}:\n"
+            f"  solo:     {want}\n"
+            f"  streamed: {results[d['id']]['result']}")
+    # idempotent re-submit of a known config returns the original
+    # placement without a second admit record
+    scan = SweepJournal(root).scan()
+    assert len([e for e in scan.events
+                if e.get("ev") == "admit"]) == len(configs)
+
+
+def test_serve_admission_refusals(tmp_path):
+    """Loud admission guards: controller/speculate configs, id-less
+    configs, and a reused run_id with a different config are all
+    ServeRejected — never a silent mis-run."""
+    from timewarp_tpu.serve.frontend import ServeRejected
+    journal = SweepJournal(str(tmp_path), host="a")
+    front = ServeFrontend(journal, "a", ("127.0.0.1", 1), slots=2)
+    with pytest.raises(ServeRejected, match="controller/speculate"):
+        front.admit({**_cfg(0, 0, 8), "controller": "auto"})
+    with pytest.raises(ServeRejected, match='explicit "id"'):
+        front.admit({k: v for k, v in _cfg(0, 0, 8).items()
+                     if k != "id"})
+    rid, bid, slot = front.admit(_cfg(0, 0, 8))
+    assert (rid, bid, slot) == ("w0", "sb0", 0)
+    # idempotent re-submit: same placement, no second admit record
+    assert front.admit(_cfg(0, 0, 8)) == ("w0", "sb0", 0)
+    with pytest.raises(ServeRejected, match="different config"):
+        front.admit(_cfg(0, 1, 8))
+    # a second key opens a second bucket
+    rid2, bid2, _ = front.admit(
+        {**_cfg(2, 0, 8), "link": "fixed:2500"})
+    assert bid2 == "sb1"

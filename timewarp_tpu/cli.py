@@ -510,6 +510,16 @@ def main(argv=None) -> int:
         # (timewarp_tpu/search/, docs/search.md): run|repro
         from .search.cli import search_main
         return search_main(argv[1:])
+    if argv and argv[0] == "serve":
+        # emulation as a service: streaming RunConfig frontend +
+        # multi-host work-stealing curators (serve/, docs/serving.md)
+        from .serve.cli import serve_main
+        return serve_main(argv[1:])
+    if argv and argv[0] == "submit":
+        # the service's client: submit configs, stream world_done
+        # results back as worlds quiesce (serve/, docs/serving.md)
+        from .serve.cli import submit_main
+        return submit_main(argv[1:])
     if argv and argv[0] == "profile":
         # full-telemetry run + Perfetto trace (docs/observability.md)
         return profile_main(argv[1:])

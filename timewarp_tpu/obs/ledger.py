@@ -350,6 +350,53 @@ class RunLedger:
         }
         return self._commit(rec)
 
+    def add_serve(self, journal_dir: str, *,
+                  batch: Optional[str] = None) -> str:
+        """Ingest a service journal dir (serve/, docs/serving.md) as
+        the ``serve`` kind: admission/steal/lease-reclaim/repack
+        rollups, the per-host lease table, and the shared event-counts
+        block — so a serving fleet's history is queryable next to
+        bench and sweep runs."""
+        from ..sweep.journal import SweepJournal, status_fields
+        j = SweepJournal(journal_dir)
+        if not j.exists():
+            raise LedgerError(
+                f"{journal_dir!r} holds no service journal "
+                "(no journal*.jsonl)")
+        scan = j.scan()
+        if not (scan.hosts or scan.admits or scan.serve_buckets):
+            raise LedgerError(
+                f"{journal_dir!r} holds no serve_open/admit/lease "
+                "records — not a service journal (sweep journals "
+                "ingest as the 'sweep' kind)")
+        open_rec = next((e for e in scan.events
+                         if e.get("ev") == "serve_open"), None)
+        host0 = (open_rec or {}).get("host") \
+            or (sorted(scan.hosts) or ["?"])[0]
+        os.makedirs(self.runs_dir, exist_ok=True)
+        fields = status_fields(scan, len(scan.admits))
+        rec = {
+            "ledger_schema": LEDGER_SCHEMA,
+            "run_id": self._next_run_id(),
+            "batch": batch or self.new_batch(),
+            "kind": "serve",
+            # stable across re-ingest of the same dir: the frontend
+            # host + its journaled open ts anchor the identity
+            "config_key": (f"serve|{host0}|"
+                           f"{int((open_rec or {}).get('ts', 0))}"),
+            "git_sha": resolve_git_sha(journal_dir),
+            "source": os.path.abspath(journal_dir),
+            "serve": {
+                **fields.get("serve", {}),
+                "completed": len(scan.done),
+                "failed": sorted(scan.failed),
+                "hosts": fields.get("hosts", {}),
+                "events": scan.event_counts(),
+                "utilization": scan.util,
+            },
+        }
+        return self._commit(rec)
+
     def add_sweep(self, journal_dir: str, *,
                   batch: Optional[str] = None) -> str:
         """Ingest a finished (or killed) sweep journal: worlds done/
@@ -430,24 +477,34 @@ class RunLedger:
         (``BENCH_r0N.json``: ``{"parsed": <line>, ...}``), or a file
         of bench JSON lines. Returns the new run_ids."""
         if os.path.isdir(path):
-            # a journal dir is a sweep unless its FIRST record says
-            # it is a chaos-search campaign (search/, docs/search.md)
-            # — sniffed from the first line only, so a large finished
+            # a journal dir is a sweep unless a FIRST record says it
+            # is a chaos-search campaign (search/, docs/search.md) or
+            # a service journal (serve/, docs/serving.md — the
+            # frontend's per-host file opens with serve_open) —
+            # sniffed from first lines only, so a large finished
             # journal is not fully parsed twice
-            first = None
+            import glob as _glob
+            firsts = []
             jp = os.path.join(path, "journal.jsonl")
-            if os.path.exists(jp):
-                with open(jp) as f:
+            paths = ([jp] if os.path.exists(jp) else []) + sorted(
+                p for p in _glob.glob(
+                    os.path.join(path, "journal-*.jsonl"))
+                if p != jp)
+            for p in paths:
+                with open(p) as f:
                     for line in f:
                         if line.strip():
                             try:
-                                first = json.loads(line)
+                                firsts.append(json.loads(line))
                             except json.JSONDecodeError:
                                 pass
                             break
-            if isinstance(first, dict) \
-                    and first.get("ev") == "search_campaign":
+            evs = {f.get("ev") for f in firsts
+                   if isinstance(f, dict)}
+            if "search_campaign" in evs:
                 return [self.add_search(path, batch=batch)]
+            if "serve_open" in evs:
+                return [self.add_serve(path, batch=batch)]
             return [self.add_sweep(path, batch=batch)]
         with open(path) as f:
             text = f.read()
@@ -509,6 +566,12 @@ def _fmt_run(r: Dict[str, Any]) -> str:
         val = (f"  FOUND {se.get('minimized')!r}"
                if se.get("found") else "  no counterexample") + \
             f" gens {se.get('generations_run')}"
+    elif r.get("kind") == "serve":
+        sv = r.get("serve", {})
+        val = (f"  admitted {sv.get('admitted')} completed "
+               f"{sv.get('completed')} steals {sv.get('steals')} "
+               f"repacks {sv.get('repacks')} hosts "
+               f"{sorted(sv.get('hosts', {}))}")
     smoke = " smoke" if r.get("smoke") else ""
     return (f"{r['run_id']}  {r.get('batch', '?'):>10}  "
             f"{r.get('kind', '?'):7s}{smoke}  "

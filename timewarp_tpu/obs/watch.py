@@ -90,8 +90,12 @@ class SweepWatch:
 
     def __init__(self, journal_dir: str) -> None:
         self.root = journal_dir
-        self.journal = TailReader(os.path.join(journal_dir,
-                                               "journal.jsonl"))
+        #: journal tails, one per file — multi-host journals
+        #: (journal-<host>.jsonl, serve/ + docs/serving.md) are
+        #: discovered per poll so a host that joins mid-watch is
+        #: picked up; single-host dirs tail journal.jsonl exactly as
+        #: before
+        self._journal_tails: Dict[str, TailReader] = {}
         self.metrics = TailReader(os.path.join(journal_dir,
                                                "metrics.jsonl"))
         self.state = JournalState()
@@ -114,7 +118,7 @@ class SweepWatch:
             self._open_buckets.add(rec.get("bucket"))
         elif ev in ("bucket_done", "bucket_split"):
             self._open_buckets.discard(rec.get("bucket"))
-        elif ev == "sweep_done":
+        elif ev in ("sweep_done", "serve_done"):
             self.finished = True
 
     def _apply_metrics(self, rec: Dict[str, Any]) -> None:
@@ -127,9 +131,34 @@ class SweepWatch:
             if isinstance(s, int):
                 self.metric_supersteps += s
 
+    def _poll_journal(self) -> List[dict]:
+        """New records across every journal file, merge-sorted by the
+        same ``(ts, host, seq)`` key :meth:`SweepJournal.records`
+        uses — so a watch over a finished multi-host journal folds in
+        the exact order ``sweep status``'s scan does. (A LIVE
+        multi-host watch can see cross-poll inversions — a slow
+        host's old record arriving after a fast host's new one — but
+        the fold is commutative on everything except the loud
+        double-journal refusals, which compare content, not order.)"""
+        from ..sweep.journal import SweepJournal, merge_key
+        fresh: List[dict] = []
+        for p in SweepJournal(self.root).journal_files():
+            tail = self._journal_tails.get(p)
+            if tail is None:
+                tail = self._journal_tails[p] = TailReader(p)
+            fresh.extend(tail.poll())
+        fresh.sort(key=merge_key)
+        return fresh
+
+    @property
+    def parse_errors_total(self) -> int:
+        return (sum(t.parse_errors
+                    for t in self._journal_tails.values())
+                + self.metrics.parse_errors)
+
     def poll(self) -> Dict[str, Any]:
         """Consume everything new and return the current snapshot."""
-        for rec in self.journal.poll():
+        for rec in self._poll_journal():
             self._apply_journal(rec)
         for rec in self.metrics.poll():
             self._apply_metrics(rec)
@@ -154,7 +183,12 @@ class SweepWatch:
         """The shared ``sweep status --json`` fields (identical by
         construction: same fold, same assembly) plus watch-only
         extras under keys status does not use."""
-        snap = status_fields(self.state, self._total_worlds)
+        total = self._total_worlds
+        if total is None and self.state.admits:
+            # a serve journal has no pack — the admission ledger is
+            # the world count (exactly what `sweep status` uses)
+            total = len(self.state.admits)
+        snap = status_fields(self.state, total)
         elapsed = time.monotonic() - self._t0
         seen = len(self.state.done) - (self._done0 or 0)
         snap["watch"] = {
@@ -166,8 +200,7 @@ class SweepWatch:
             "finished": self.finished,
             "metrics_kinds": dict(self.metric_kinds),
             "metrics_supersteps": self.metric_supersteps,
-            "parse_errors": (self.journal.parse_errors
-                             + self.metrics.parse_errors),
+            "parse_errors": self.parse_errors_total,
         }
         return snap
 
@@ -199,6 +232,22 @@ class SweepWatch:
         if w["metrics_kinds"]:
             parts.append(
                 f"metrics {sum(w['metrics_kinds'].values())} lines")
+        hosts = snap.get("hosts")
+        if hosts:
+            # the serving fleet's per-host line: leases held,
+            # heartbeat AGE (derived at render time from the folded
+            # ts — the folded fields themselves stay deterministic),
+            # stolen-bucket counts
+            now = time.time()
+            bits = []
+            for name, h in hosts.items():
+                hb = h.get("last_heartbeat")
+                age = f"{now - hb:.1f}s" if hb is not None else "?"
+                bits.append(f"{name}:{len(h['leases'])}lease"
+                            f"/hb {age}"
+                            + (f"/stole {h['stolen']}"
+                               if h["stolen"] else ""))
+            parts.append("hosts " + " ".join(bits))
         parts.append(f"{w['worlds_done_per_s']:g} worlds/s")
         status = "DONE" if w["finished"] else "live"
         return f"sweep {status} | " + " | ".join(parts)

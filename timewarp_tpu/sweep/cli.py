@@ -93,6 +93,16 @@ def _service_args(p: argparse.ArgumentParser) -> None:
                    help="after the sweep, re-run every completed "
                         "world solo and assert the streamed result is "
                         "bit-identical (the sweep survival law)")
+    p.add_argument("--hosts", default=None,
+                   help="multi-host mode (serve/, docs/serving.md): "
+                        "NAME[,PEER...] — first entry is THIS "
+                        "process's identity (HOST_GRAMMAR). N "
+                        "processes sharing one --journal cooperate "
+                        "through per-bucket leases; a dead host's "
+                        "buckets are stolen after --lease-ttl-s and "
+                        "continue from their shared checkpoints")
+    p.add_argument("--lease-ttl-s", type=float, default=30.0,
+                   help="lease staleness TTL for --hosts mode")
     p.add_argument("--telemetry", default="off",
                    choices=["off", "counters", "full"],
                    help="engine telemetry mode (obs/, "
@@ -122,6 +132,10 @@ def _kw(args) -> dict:
     if args.trace_out and args.telemetry == "off":
         raise SystemExit("--trace-out needs --telemetry "
                          "counters|full (off records nothing)")
+    host = None
+    if args.hosts is not None:
+        from ..serve.hosts import parse_hosts
+        host = parse_hosts(args.hosts)[0].name
     return dict(chunk=args.chunk, max_retries=args.retries,
                 backoff_us=args.backoff_us,
                 bucket_timeout_us=args.timeout_us,
@@ -129,6 +143,7 @@ def _kw(args) -> dict:
                 lint=args.lint, inject=args.inject,
                 telemetry=args.telemetry, trace_out=args.trace_out,
                 verify=args.state_verify, record=args.record,
+                host=host, lease_ttl_s=args.lease_ttl_s,
                 # a promised post-sweep --verify arms the flip guard's
                 # other legal detection path (service.py)
                 post_verify=args.verify)
@@ -272,15 +287,23 @@ def _status(argv) -> int:
     import os
 
     from .journal import status_fields
-    if not os.path.exists(j.pack_path):
+    if os.path.exists(j.pack_path):
+        total = len(SweepPack.load(j.pack_path).configs)
+        scan = j.scan()
+    elif j.exists():
+        # a serve journal dir (docs/serving.md) has no pack — the
+        # world count is the admission ledger's
+        scan = j.scan()
+        total = len(scan.admits)
+    else:
         raise SystemExit(
-            f"{args.journal!r} holds no sweep (no pack.json)")
-    pack = SweepPack.load(j.pack_path)
+            f"{args.journal!r} holds no sweep (no pack.json and no "
+            "journal files)")
     # ONE shared fold + assembly (journal.py status_fields) behind
     # both this line and `sweep watch`'s aggregates — the two
     # surfaces report identical numbers from the same journal by
     # construction (docs/observability.md "Fleet observability")
-    print(json.dumps(status_fields(j.scan(), len(pack.configs))))
+    print(json.dumps(status_fields(scan, total)))
     return 0
 
 
@@ -316,11 +339,10 @@ def _watch(argv) -> int:
     import time as _time
 
     from ..obs.watch import SweepWatch
-    if args.once and not os.path.exists(
-            os.path.join(args.journal, "journal.jsonl")):
+    if args.once and not SweepJournal(args.journal).exists():
         raise SystemExit(
             f"{args.journal!r} holds no sweep journal to snapshot "
-            "(no journal.jsonl)")
+            "(no journal*.jsonl)")
     w = SweepWatch(args.journal)
     deadline = None if args.max_seconds is None \
         else _time.monotonic() + args.max_seconds

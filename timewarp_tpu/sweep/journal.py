@@ -21,10 +21,23 @@ done-set makes re-happening idempotent). A ``world_done`` seen twice
 with *different* results is the one unforgivable state — it means two
 result streams claimed the same world — and scan fails loudly rather
 than pick one.
+
+Multi-host mode (the serving layer, serve/ + docs/serving.md): with
+``host="name"`` each cooperating process appends to its OWN
+``journal-<name>.jsonl`` (never a shared file — concurrent appends
+from two processes could interleave inside a line), with every record
+stamped ``host``/``seq``/``ts`` (``ts`` monotone per journal handle).
+:meth:`records` merges every journal file in the directory, sorted by
+``(ts, host, seq)`` — per-host causal order is preserved, cross-host
+order follows wall time — and applies the torn-final-line tolerance
+*per file* (any host may have crashed mid-append). With ``host=None``
+(the default) nothing changes: one ``journal.jsonl``, unstamped
+records, byte-identical to the single-host service since r10.
 """
 
 from __future__ import annotations
 
+import glob as _glob
 import json
 import logging
 import os
@@ -32,7 +45,16 @@ from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Set
 
 __all__ = ["SweepJournal", "JournalState", "SweepJournalError",
-           "status_fields"]
+           "status_fields", "merge_key"]
+
+
+def merge_key(rec: Dict[str, Any]):
+    """THE multi-host merge ordering — ``(ts, host, seq)`` — shared by
+    :meth:`SweepJournal.records`, the live watch tail (obs/watch.py),
+    and the serve frontend's result tail (serve/frontend.py), so the
+    file-merge convention cannot drift between readers."""
+    return (float(rec.get("ts", 0.0)), str(rec.get("host", "")),
+            int(rec.get("seq", 0)))
 
 _log = logging.getLogger("timewarp.sweep")
 
@@ -85,6 +107,25 @@ class JournalState:
     #: obs.bisect.first_trail_divergence to name the first diverging
     #: chunk on a survival-law mismatch
     chains: Dict[str, list] = field(default_factory=dict)
+    #: host name -> serving-fleet facts (serve/, docs/serving.md):
+    #: leases held, last journaled heartbeat ts, stolen-bucket count,
+    #: listen address — folded from serve_open / host_heartbeat /
+    #: lease_* records, so `sweep status` and the live watch report
+    #: the SAME hosts block from the same fold
+    hosts: Dict[str, dict] = field(default_factory=dict)
+    #: run_id -> admit record ({"bucket", "slot", "config"}) — the
+    #: serving layer's admission ledger: curators rebuild open-bucket
+    #: membership from exactly this (the journal IS the queue)
+    admits: Dict[str, dict] = field(default_factory=dict)
+    #: bucket_id -> bucket_open record (key sha, window, capacity) —
+    #: the serving layer's open-bucket table
+    serve_buckets: Dict[str, dict] = field(default_factory=dict)
+    #: repack events (serve/worker.py): each one an under-occupied
+    #: open bucket merged into a same-key peer between chunks
+    repacks: List[dict] = field(default_factory=list)
+    #: True once a serve_drain record landed: the frontend stopped
+    #: admitting; curators exit when every admitted world settles
+    draining: bool = False
 
     def apply(self, rec: Dict[str, Any]) -> None:
         """Fold ONE journal record into this state — the single fold
@@ -143,6 +184,57 @@ class JournalState:
             # run, so summing across records totals the sweep
             for rid, n in rec.get("counts", {}).items():
                 self.flight[rid] = self.flight.get(rid, 0) + int(n)
+        elif ev == "serve_open":
+            h = self._host(rec["host"])
+            h["listen"] = rec.get("listen")
+            h["last_heartbeat"] = rec.get("ts")
+        elif ev == "host_heartbeat":
+            self._host(rec["host"])["last_heartbeat"] = rec.get("ts")
+        elif ev == "lease_acquire":
+            h = self._host(rec["host"])
+            h["leases"].add(rec["bucket"])
+            h["last_heartbeat"] = rec.get("ts", h["last_heartbeat"])
+            if rec.get("stolen_from"):
+                h["stolen"] += 1
+                h["stolen_buckets"].append(
+                    {"bucket": rec["bucket"],
+                     "from": rec["stolen_from"]})
+            # a steal implicitly evicts the dead holder's lease row
+            prev = self.hosts.get(rec.get("stolen_from") or "")
+            if prev is not None:
+                prev["leases"].discard(rec["bucket"])
+        elif ev == "lease_release":
+            self._host(rec["host"])["leases"].discard(rec["bucket"])
+        elif ev == "bucket_open":
+            self.serve_buckets[rec["bucket"]] = {
+                k: v for k, v in rec.items() if k != "ev"}
+        elif ev == "admit":
+            rid = rec["run_id"]
+            prev = self.admits.get(rid)
+            if prev is not None \
+                    and prev.get("config") != rec.get("config"):
+                raise SweepJournalError(
+                    f"world {rid!r} is double-admitted with "
+                    f"DIFFERENT configs — refusing to pick one:\n"
+                    f"  first:  {prev.get('config')}\n"
+                    f"  second: {rec.get('config')}")
+            # same config: either an idempotent client re-submit (a
+            # retried lost reply — harmless by design) or a repack
+            # re-point to the merged bucket. A re-point (marked
+            # ``repacked_from``) beats an original REGARDLESS of
+            # merge order — cross-host wall clocks order the merge,
+            # and a skewed clock must not resurrect the donor bucket
+            # (which closed at repack); among records of equal
+            # authority, last wins
+            if prev is None or "repacked_from" in rec \
+                    or "repacked_from" not in prev:
+                self.admits[rid] = {
+                    k: v for k, v in rec.items() if k != "ev"}
+        elif ev == "repack":
+            self.repacks.append(
+                {k: v for k, v in rec.items() if k != "ev"})
+        elif ev == "serve_drain":
+            self.draining = True
         elif ev == "dispatch_decision":
             dl = self.decisions.setdefault(rec["bucket"], [])
             d = rec["decision"]
@@ -199,13 +291,58 @@ class JournalState:
                     out.append(d)
         return sorted(out, key=lambda d: d["chunk"])
 
+    # -- the serving fleet's folded views (serve/, docs/serving.md) ------
+
+    def _host(self, name: str) -> dict:
+        return self.hosts.setdefault(name, {
+            "leases": set(), "last_heartbeat": None, "stolen": 0,
+            "stolen_buckets": [], "listen": None})
+
+    def hosts_block(self) -> Dict[str, dict]:
+        """The per-host lease table for ``sweep status --json`` and
+        the live watch — one assembly over the one fold, so the two
+        surfaces agree by construction. ``last_heartbeat`` is the
+        journaled wall ts (deterministic from the fold); readers
+        derive heartbeat *age* from it at render time."""
+        return {name: {
+            "leases": sorted(h["leases"]),
+            "last_heartbeat": h["last_heartbeat"],
+            "stolen": h["stolen"],
+            "stolen_buckets": list(h["stolen_buckets"]),
+            "listen": h["listen"],
+        } for name, h in sorted(self.hosts.items())}
+
+    def serve_block(self) -> Dict[str, Any]:
+        """Admission/steal/repack rollup of a service journal — what
+        the ledger ingests as the ``serve`` kind and ``sweep status``
+        surfaces next to the hosts block."""
+        return {
+            "admitted": len(self.admits),
+            "open_buckets": sorted(self.serve_buckets),
+            "steals": sum(h["stolen"] for h in self.hosts.values()),
+            "repacks": len(self.repacks),
+            "draining": self.draining,
+        }
+
 
 class SweepJournal:
-    def __init__(self, root: str) -> None:
+    def __init__(self, root: str, host: Optional[str] = None) -> None:
         self.root = root
-        self.path = os.path.join(root, "journal.jsonl")
+        #: multi-host mode (module docstring): this process's own
+        #: append file; merged reads see every host's file
+        self.host = host
+        self.path = os.path.join(
+            root, f"journal-{host}.jsonl" if host else "journal.jsonl")
         self.pack_path = os.path.join(root, "pack.json")
         self._fh = None
+        self._seq = 0
+        self._last_ts = 0.0
+        # one process may append from two threads sharing a handle
+        # (the serve frontend's event loop + its embedded curator,
+        # serve/frontend.py) — the lock keeps lines whole and seq
+        # stamps unique; cross-PROCESS writers use per-host files
+        import threading
+        self._wlock = threading.Lock()
         #: optional observability hook: called as ``on_append(ev,
         #: wall_s)`` after every durable append — the sweep service
         #: wires it to the Perfetto timeline so fsync stalls are
@@ -234,15 +371,39 @@ class SweepJournal:
         leans on."""
         import time as _time
         t0 = _time.perf_counter()
-        if self._fh is None:
-            self.ensure_dir()
-            self._fh = open(self.path, "a")
-        self._fh.write(json.dumps(rec, sort_keys=True) + "\n")
-        self._fh.flush()
-        os.fsync(self._fh.fileno())
+        with self._wlock:
+            if self._fh is None:
+                self.ensure_dir()
+                self._fh = open(self.path, "a")
+            if self.host is not None:
+                # the multi-host merge stamp: per-host seq (causal
+                # order within a file) + a ts kept monotone per handle
+                # so the (ts, host, seq) merge sort can never invert
+                # one host's own appends even across a wall-clock
+                # step back
+                self._seq += 1
+                self._last_ts = max(self._last_ts, _time.time())
+                rec = {**rec, "host": self.host, "seq": self._seq,
+                       "ts": round(self._last_ts, 6)}
+            self._fh.write(json.dumps(rec, sort_keys=True) + "\n")
+            self._fh.flush()
+            os.fsync(self._fh.fileno())
         if self.on_append is not None:
             self.on_append(rec.get("ev", "?"),
                            _time.perf_counter() - t0)
+
+    def maybe_heartbeat(self, min_interval_s: float = 1.0) -> None:
+        """Journal a throttled ``host_heartbeat`` (multi-host mode
+        only) — the fold's ``last_heartbeat`` behind the hosts block's
+        heartbeat-age view. The lease files carry the load-bearing
+        liveness (lease.py); this is the observability mirror."""
+        if self.host is None:
+            return
+        import time as _time
+        now = _time.monotonic()
+        if now - getattr(self, "_hb_mono", 0.0) >= min_interval_s:
+            self._hb_mono = now
+            self.append({"ev": "host_heartbeat", "host": self.host})
 
     def close(self) -> None:
         if self._fh is not None:
@@ -254,16 +415,25 @@ class SweepJournal:
 
     # -- reading -----------------------------------------------------------
 
-    def exists(self) -> bool:
-        return os.path.exists(self.path)
+    def journal_files(self) -> List[str]:
+        """Every journal file in the directory: the single-host
+        ``journal.jsonl`` (if present) plus every per-host
+        ``journal-<name>.jsonl``, in sorted order."""
+        out = []
+        single = os.path.join(self.root, "journal.jsonl")
+        if os.path.exists(single):
+            out.append(single)
+        out.extend(sorted(
+            p for p in _glob.glob(os.path.join(self.root,
+                                               "journal-*.jsonl"))
+            if p != single))
+        return out
 
-    def records(self) -> List[dict]:
-        """Parse the log. A torn *final* line (crash mid-append) is
-        dropped with a warning; an unparsable line anywhere else is
-        corruption and fails loudly."""
-        if not self.exists():
-            return []
-        with open(self.path) as f:
+    def exists(self) -> bool:
+        return bool(self.journal_files())
+
+    def _parse_file(self, path: str) -> List[dict]:
+        with open(path) as f:
             lines = f.read().splitlines()
         out: List[dict] = []
         for i, line in enumerate(lines):
@@ -275,14 +445,30 @@ class SweepJournal:
                 if i == len(lines) - 1:
                     _log.warning(
                         "sweep journal %s: dropping torn final line "
-                        "(crash mid-append): %r", self.path, line[:80])
+                        "(crash mid-append): %r", path, line[:80])
                     continue
                 raise SweepJournalError(
-                    f"sweep journal {self.path!r} line {i + 1} is "
+                    f"sweep journal {path!r} line {i + 1} is "
                     f"corrupt mid-file ({e}); a crash can only tear "
                     "the last line — this journal has been damaged "
                     "externally") from None
         return out
+
+    def records(self) -> List[dict]:
+        """Parse the log(s). A torn *final* line (crash mid-append) is
+        dropped with a warning — per file: in multi-host mode any host
+        may have crashed mid-append; an unparsable line anywhere else
+        is corruption and fails loudly. Multiple host files merge
+        sorted by ``(ts, host, seq)`` (module docstring)."""
+        files = self.journal_files()
+        if not files:
+            return []
+        if len(files) == 1 and files[0] == os.path.join(
+                self.root, "journal.jsonl"):
+            # the single-host fast path: exactly the pre-serve reader
+            return self._parse_file(files[0])
+        recs = [r for p in files for r in self._parse_file(p)]
+        return sorted(recs, key=merge_key)
 
     def scan(self) -> JournalState:
         st = JournalState()
@@ -305,7 +491,7 @@ def status_fields(scan: JournalState,
     construction. ``total_worlds`` is the pack's world count (None
     when a watcher attached before ``pack.json`` was written)."""
     done, failed = len(scan.done), len(scan.failed)
-    return {
+    out = {
         "worlds": total_worlds, "completed": done,
         "failed": sorted(scan.failed),
         "pending": (None if total_worlds is None
@@ -334,3 +520,11 @@ def status_fields(scan: JournalState,
         # `timewarp-tpu explain`)
         "flight_events": scan.flight,
         "pack_sha": scan.pack_sha}
+    if scan.hosts or scan.admits or scan.serve_buckets:
+        # the serving fleet's blocks (serve/, docs/serving.md) —
+        # present ONLY when host/lease/admission events exist, so a
+        # plain single-host sweep's status line stays byte-identical
+        # to the pre-serve service
+        out["hosts"] = scan.hosts_block()
+        out["serve"] = scan.serve_block()
+    return out
