@@ -621,8 +621,15 @@ def bench_gossip_100k_b8(n, steps):
         window=engine.window))
     delivered, dt, fin = _measure(engine, steps or (1 << 20))
     _assert_wave_done(engine, fin, n)
+    stats = engine.last_run_stats or {}
+    assert int(stats.get("compiles", 0)) == 0, (
+        f"a MEASURED rep recompiled the warmed executable: {stats} — "
+        "per-world identity rides as traced operands precisely so "
+        "the fleet executable compiles once (batched.WorldIdentity)")
     return (f"gossip broadcast wave fleet (batched x{B}) aggregate "
-            f"delivered-messages/sec/chip @{n} nodes", delivered / dt)
+            f"delivered-messages/sec/chip @{n} nodes", delivered / dt,
+            {"engine_builds": 1,
+             "compiles": int(stats.get("compiles", 0))})
 
 
 def bench_gossip_100k_chaos(n, steps):
@@ -1404,14 +1411,20 @@ def bench_serve_gossip(n, steps):
     engine rebuilds, checkpoints, result streaming) from loopback
     latency; the CI serve-smoke job measures the wire path. Eight
     gossip configs (heterogeneous seeds + budgets, one faulted) are
-    submitted against 4-slot open buckets — half up front, half
+    submitted against ONE 8-slot open bucket — half up front, half
     mid-bucket while the first chunks run, so admission-into-reserved-
-    slots is exercised every round. Reports end-to-end served
-    configs/sec (first admit -> last world_done, journal ts) plus
-    admission throughput and p50/p95 submit->world_done latency on
-    the BENCH_SCHEMA=2 line. Gated by the extended survival law
-    before the number counts: every streamed record's result must be
-    bit-identical to the solo run of its config."""
+    slots is exercised every round AGAINST A WARM EXECUTABLE: the
+    zero-recompile law (identity as traced operands, serve/worker.py)
+    is gated in-bench — the journaled ``bucket_util`` must report
+    ``engine_builds == 1`` across every mid-bucket admission, and
+    both counters ride the JSON line so the ledger can gate
+    ``admit_per_s`` against its causal explanation. Reports
+    end-to-end served configs/sec (first admit -> last world_done,
+    journal ts) plus admission throughput and p50/p95
+    submit->world_done latency on the BENCH_SCHEMA=2 line. Gated by
+    the extended survival law before the number counts: every
+    streamed record's result must be bit-identical to the solo run
+    of its config."""
     import shutil
     import tempfile
     import threading
@@ -1437,7 +1450,7 @@ def bench_serve_gossip(n, steps):
     try:
         journal = SweepJournal(root, host="bench")
         front = ServeFrontend(journal, "bench", ("127.0.0.1", 0),
-                              slots=4)
+                              slots=8)
         cur = ServeCurator(root, "bench", chunk=max(32, steps // 8),
                            lint="off", lease_ttl_s=60.0,
                            poll_s=0.02, journal=journal)
@@ -1481,6 +1494,18 @@ def bench_serve_gossip(n, steps):
         p50 = lats[len(lats) // 2]
         p95 = lats[min(len(lats) - 1, int(len(lats) * 0.95))]
         delivered = sum(r["delivered"] for r in scan.done.values())
+        # the zero-recompile serving gate: 4 of the 8 configs landed
+        # mid-bucket (one faulted, fault-pad-compatible with the
+        # warmup build), yet the bucket's executable compiled ONCE —
+        # admission is an operand write, never a rebuild
+        builds = {b: u.get("engine_builds")
+                  for b, u in scan.util.items()}
+        assert builds and all(v == 1 for v in builds.values()), (
+            f"mid-bucket admission rebuilt an engine: {builds} — "
+            "the zero-recompile serving law "
+            "(serve/worker.py rebind_identity)")
+        compiles = sum(int(u.get("compiles", 0))
+                       for u in scan.util.values())
         extra = {
             "worlds": len(cfgs),
             "admit_per_s": round(
@@ -1489,6 +1514,8 @@ def bench_serve_gossip(n, steps):
             "submit_p50_s": round(p50, 4),
             "submit_p95_s": round(p95, 4),
             "buckets": len(scan.serve_buckets),
+            "engine_builds": sum(builds.values()),
+            "compiles": compiles,
             "delivered_per_s": round(delivered / dt, 2),
         }
     finally:

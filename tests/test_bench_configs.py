@@ -54,10 +54,14 @@ def test_bench_config_runs(cfg):
         assert extra["rollbacks"] >= 0
     if cfg == "serve_gossip":
         # the serving-layer config's in-bench extended-survival-law
-        # gate already ran; the line must carry the honest latency
-        # and admission numbers (ISSUE 15 satellite)
+        # AND zero-recompile gates already ran; the line must carry
+        # the honest latency/admission numbers plus the build/compile
+        # counters — ONE 8-slot bucket, ONE engine build across every
+        # mid-bucket admission (identity rides as traced operands)
         assert extra["worlds"] == 8
-        assert extra["buckets"] >= 2
+        assert extra["buckets"] == 1
+        assert extra["engine_builds"] == 1
+        assert extra["compiles"] >= 0
         assert extra["admit_per_s"] > 0
         assert 0 <= extra["submit_p50_s"] <= extra["submit_p95_s"]
         assert extra["delivered_per_s"] > 0

@@ -402,13 +402,15 @@ def test_serve_tcp_roundtrip_streams_bit_identical(tmp_path):
 
 
 def test_serve_admission_refusals(tmp_path):
-    """Loud admission guards: controller/speculate configs, id-less
-    configs, and a reused run_id with a different config are all
-    ServeRejected — never a silent mis-run."""
+    """Loud admission guards: controller configs, id-less configs,
+    and a reused run_id with a different config are all ServeRejected
+    — never a silent mis-run. Speculate configs are ADMITTED (per-slot
+    decision chains make them serveable) into their OWN bucket: the
+    key includes the speculate mode."""
     from timewarp_tpu.serve.frontend import ServeRejected
     journal = SweepJournal(str(tmp_path), host="a")
     front = ServeFrontend(journal, "a", ("127.0.0.1", 1), slots=2)
-    with pytest.raises(ServeRejected, match="controller/speculate"):
+    with pytest.raises(ServeRejected, match="controller"):
         front.admit({**_cfg(0, 0, 8), "controller": "auto"})
     with pytest.raises(ServeRejected, match='explicit "id"'):
         front.admit({k: v for k, v in _cfg(0, 0, 8).items()
@@ -423,3 +425,8 @@ def test_serve_admission_refusals(tmp_path):
     rid2, bid2, _ = front.admit(
         {**_cfg(2, 0, 8), "link": "fixed:2500"})
     assert bid2 == "sb1"
+    # a speculate config is ADMITTED — into its own bucket, because
+    # the decision-source mode is part of the bucket key
+    rid3, bid3, _ = front.admit(
+        {**_cfg(3, 0, 8), "speculate": "fixed:6000"})
+    assert bid3 == "sb2"

@@ -1,0 +1,205 @@
+"""Zero-recompile serving core: identity as traced operands (ISSUE 16).
+
+Per-world identity — seed words, sweepable link values, fault tables
+— rides the batched executable as TRACED OPERANDS
+(``WorldIdentity``, interp/jax_engine/batched.py), so the compiled
+function is a pure function of the bucket's *shape*. Pinned here:
+
+- the **zero-recompile admission law**: after one warmup chunk, 8
+  sequential mid-bucket admissions plus a fault-pad-compatible
+  faulted admission re-enter the SAME executable — jit cache delta
+  == 0, ``engine_builds`` == 1, the engine OBJECT survives — and
+  every admitted world still streams its solo-exact result;
+- **rebind exactness**: ``rebind_identity`` onto a warm engine is
+  bit-identical to a fresh build with the same identity (states and
+  traces) at zero additional compiles;
+- **pad inertness with operand tables**: fault tables are operands
+  now, and pad rows stay inert — a wider-padded fleet is trace- and
+  counter-identical;
+- the **masked re-run law**: a single violating world in an 8-world
+  speculative bucket re-runs alone at the floor; the other 7 worlds'
+  committed progress survives, every world bit-identical to its solo
+  run on the canonical surface (speculate/equiv.py).
+
+Named with ten z's to sort dead last (the 870 s tier-1 window
+truncates from the END; new tests must not displace existing dots).
+"""
+
+import numpy as np
+
+from timewarp_tpu.faults import FaultFleet, FaultSchedule, NodeCrash
+from timewarp_tpu.interp.jax_engine.batched import BatchSpec
+from timewarp_tpu.interp.jax_engine.engine import JaxEngine
+from timewarp_tpu.models.gossip import gossip
+from timewarp_tpu.models.token_ring import token_ring, token_ring_links
+from timewarp_tpu.net.delays import UniformDelay
+from timewarp_tpu.serve.worker import OpenBucketRunner
+from timewarp_tpu.speculate import assert_spec_equiv, canonical_rows
+from timewarp_tpu.sweep.journal import SweepJournal
+from timewarp_tpu.sweep.spec import (RunConfig, resolve_window,
+                                     solo_result)
+from timewarp_tpu.trace.events import (assert_states_equal,
+                                       assert_traces_equal)
+
+RING = {"nodes": 64, "n_tokens": 4, "think_us": 2000,
+        "end_us": 1 << 40, "mailbox_cap": 8}
+
+
+def _cfg(i, seed, budget, faults=None, link="uniform:1000:5000"):
+    d = {"id": f"w{i}", "scenario": "token-ring", "params": RING,
+         "link": link, "seed": seed, "budget": budget}
+    if faults:
+        d["faults"] = faults
+    return d
+
+
+# ---------------------------------------------------------------------------
+# the zero-recompile admission law (serving layer)
+# ---------------------------------------------------------------------------
+
+def test_zero_recompile_admission_law(tmp_path):
+    """Warmup chunk, then 8 sequential admissions (per-world link
+    values varying — same structure, same resolved window) plus a
+    fault-pad-compatible faulted admission: jit cache delta 0, one
+    engine build, engine object identity preserved — and the results
+    stay solo-exact, so the zero recompiles are not bought with
+    wrong answers."""
+    journal = SweepJournal(str(tmp_path), host="a")
+    done = {}
+    c0 = RunConfig.from_json(
+        _cfg(0, 0, 48, faults="crash:3:5ms:40ms:reset"), 0)
+    runner = OpenBucketRunner("zb0", journal, done, capacity=10,
+                              window=resolve_window(c0), chunk=8)
+    runner.admit(0, c0)
+    assert runner.step() == "running"        # warmup: the ONE build
+    eng = runner.engine
+    assert runner.util["engine_builds"] == 1
+    c_before = eng._driver_compiles()
+    cfgs = [c0]
+    for i in range(1, 9):                    # 8 sequential admissions
+        cfg = RunConfig.from_json(
+            _cfg(i, i, 48, link=f"uniform:1000:{4000 + 250 * i}"), 0)
+        cfgs.append(cfg)
+        runner.admit(i, cfg)
+        assert runner.step() == "running"
+        assert runner.engine is eng, f"admission {i} rebuilt"
+    # the fault-pad-compatible faulted admission: same table shapes
+    # (one reset crash) as the warmup config realized — new VALUES,
+    # same operand shapes, same executable
+    cf = RunConfig.from_json(
+        _cfg(9, 9, 48, faults="crash:5:7ms:30ms:reset"), 0)
+    cfgs.append(cf)
+    runner.admit(9, cf)
+    assert runner.step() == "running"
+    assert runner.engine is eng
+    assert eng._driver_compiles() - c_before == 0, \
+        "mid-bucket admission recompiled the bucket executable"
+    assert runner.util["engine_builds"] == 1
+    while runner.step() == "running":
+        pass
+    assert eng._driver_compiles() - c_before == 0
+    # the idle transition journaled the utilization record with the
+    # build counter (what `sweep status`/`watch` and CI gate on)
+    u = journal.scan().util["zb0"]
+    assert u["engine_builds"] == 1
+    assert u["compiles"] >= 1                # the warmup compile
+    # zero recompiles AND right answers: faulted + latest-admitted
+    # worlds stream solo-exact results
+    for cfg in (cfgs[9], cfgs[8], cfgs[0]):
+        assert solo_result(cfg, lint="off") == done[cfg.run_id], \
+            f"{cfg.run_id} diverged from its solo run"
+
+
+# ---------------------------------------------------------------------------
+# rebind exactness (engine layer)
+# ---------------------------------------------------------------------------
+
+def test_rebind_identity_exactness():
+    """Swapping seeds + same-shape fault tables onto a WARM engine
+    via rebind_identity is bit-identical to a fresh build with that
+    identity — zero additional compiles on the warm instance."""
+    sc = token_ring(16, n_tokens=4, think_us=2_000, bootstrap_us=1_000,
+                    end_us=150_000, with_observer=True, mailbox_cap=16)
+    link = token_ring_links(16)
+    f1 = FaultFleet((FaultSchedule((
+        NodeCrash(3, 20_000, 60_000, reset_state=True),)),
+        FaultSchedule(())))
+    f2 = FaultFleet((FaultSchedule((
+        NodeCrash(5, 25_000, 65_000, reset_state=True),)),
+        FaultSchedule(())))
+    eng = JaxEngine(sc, link, window="auto",
+                    batch=BatchSpec(seeds=(0, 1)), faults=f1)
+    eng.run(300)                                 # warm the executable
+    c0 = eng._driver_compiles()
+    assert eng.rebind_identity(BatchSpec(seeds=(2, 3)), faults=f2)
+    st2, tr2 = eng.run(300)
+    assert eng._driver_compiles() == c0, "rebind recompiled"
+    fresh = JaxEngine(sc, link, window="auto",
+                      batch=BatchSpec(seeds=(2, 3)), faults=f2)
+    st3, tr3 = fresh.run(300)
+    assert_states_equal(st2, st3, "rebound vs fresh")
+    for b in range(2):
+        assert_traces_equal(tr3[b], tr2[b], "fresh", f"rebound w{b}")
+
+
+def test_pad_inertness_operand_tables():
+    """Fault tables ride as traced operands now; pad rows must stay
+    inert: a wider-padded fleet is trace-identical and counter-
+    identical (restart_done width differs by construction, so the
+    compare surface is traces + the never-silent counter)."""
+    sc = token_ring(16, n_tokens=4, think_us=2_000, bootstrap_us=1_000,
+                    end_us=150_000, with_observer=True, mailbox_cap=16)
+    link = token_ring_links(16)
+    sched = FaultSchedule((
+        NodeCrash(3, 20_000, 60_000, reset_state=True),))
+    narrow = FaultFleet((sched, FaultSchedule(())))
+    wide = FaultFleet((sched.padded(3, 1, 1), FaultSchedule(())))
+    en = JaxEngine(sc, link, window="auto",
+                   batch=BatchSpec(seeds=(0, 1)), faults=narrow)
+    ew = JaxEngine(sc, link, window="auto",
+                   batch=BatchSpec(seeds=(0, 1)), faults=wide)
+    fn, tn = en.run(300)
+    fw, tw = ew.run(300)
+    for b in range(2):
+        assert_traces_equal(tn[b], tw[b], "narrow", f"wide w{b}")
+    assert np.array_equal(np.asarray(fn.fault_dropped),
+                          np.asarray(fw.fault_dropped))
+
+
+# ---------------------------------------------------------------------------
+# the masked re-run law (speculation)
+# ---------------------------------------------------------------------------
+
+def test_masked_rerun_preserves_clean_worlds():
+    """One world of an 8-world speculative bucket is FORCED to
+    violate (its link floor sits below the fixed window; the other
+    seven declare floors above it, so they can never violate): the
+    rollback re-runs ONLY that world at the floor, the other seven
+    worlds' committed chunks survive untouched, and every world —
+    clean and recovered — lands bit-identical to its solo run on the
+    canonical surface."""
+    sc = gossip(48, fanout=3, burst=True, end_us=250_000,
+                mailbox_cap=16, think_us=700)
+    los = [6_000] * 8
+    los[3] = 500                 # the one world that CAN violate
+    spec = BatchSpec(seeds=tuple(range(8)),
+                     link_params={"lo": los})
+    eng = JaxEngine(sc, UniformDelay(6_000, 9_000), window="auto",
+                    lint="off", batch=spec, speculate="fixed:3000")
+    assert eng.spec_floor == 500
+    st, rows = eng.run_speculative(np.full(8, 1_000), chunk=16)
+    rec = eng.last_run_speculation
+    assert rec["rollbacks"] >= 1, "no violation was forced"
+    assert rec["rerun_worlds"] >= 1
+    violators = {b for b, chain
+                 in enumerate(eng.last_run_decisions_world)
+                 if any(d.obs.get("rolled_back") for d in chain)}
+    assert violators == {3}, violators
+    canon = canonical_rows(st, rows, B=8)
+    for b in range(8):
+        solo = JaxEngine(sc, UniformDelay(los[b], 9_000),
+                         window="auto", lint="off", seed=b)
+        cfin, ctr = solo.run(1_000)
+        got = dict(canon[b], world=0)
+        assert_spec_equiv([got], canonical_rows(cfin, ctr),
+                          f"world {b}")

@@ -42,11 +42,28 @@ from __future__ import annotations
 
 import dataclasses
 from dataclasses import dataclass
-from typing import Any, Mapping, Optional, Sequence, Tuple
+from typing import Any, Mapping, NamedTuple, Optional, Sequence, Tuple
 
 import numpy as np
 
-__all__ = ["BatchSpec", "rebind_link", "world_slice"]
+__all__ = ["BatchSpec", "WorldIdentity", "rebind_link", "world_slice"]
+
+
+class WorldIdentity(NamedTuple):
+    """The fleet's per-world *identity* as ONE traced-operand pytree:
+    seed words, link-parameter vectors, and (optional) fault tables,
+    all with a leading world axis B. The batched drivers thread this
+    through ``jit`` as ordinary traced operands — never compile-time
+    constants — so the compiled executable is a pure function of the
+    bucket's *shape* (scenario params, link structure, window, pad
+    dims, B), and swapping identity (a new admission's seed, link
+    values, or same-shape fault schedule) re-invokes the SAME
+    executable with new device arrays: zero recompiles
+    (``JaxEngine.rebind_identity``; docs/serving.md)."""
+    s0v: Any          # uint32[B] — per-world seed word 0
+    s1v: Any          # uint32[B] — per-world seed word 1
+    lpv: Any          # dict dotted-path -> [B] link-parameter vectors
+    ftv: Any          # FaultTables with leading [B] axis, or None
 
 
 def _split_params(params: Mapping[str, Any]):

@@ -47,7 +47,7 @@ from ...core.scenario import NEVER, Inbox, Outbox, Scenario
 from ...net.delays import LinkModel
 from ...trace.events import SuperstepTrace
 from ...trace.hashing import FIRED, RECV, SENT, mix32_jnp
-from .batched import BatchSpec, rebind_link
+from .batched import BatchSpec, WorldIdentity, rebind_link
 from .common import I32MAX as _I32MAX
 from .common import LocalComm, RunStatsMixin, StepOut as _StepOut
 from .common import group_rank
@@ -560,6 +560,12 @@ class JaxEngine(RunStatsMixin, ControlledRunMixin, VerifiedRunMixin,
         # controller-less jaxpr is unchanged) on the static path.
         self._dyn = None
         self._w_now = self.window
+        # per-world identity as a traced operand (batched.py
+        # WorldIdentity): the drivers bind the operand onto `self`
+        # for the one trace jit performs — same pattern as `_dyn` —
+        # so seeds/link values/fault tables are never baked into the
+        # executable. None between driver calls (and always, solo).
+        self._ident_in = None
         # `_dyn_ok` was fixed BEFORE window validation (above): a
         # Pallas insertion stage bakes the window into kernel
         # arithmetic (the in-kernel short-delay counter compares
@@ -1903,12 +1909,117 @@ class JaxEngine(RunStatsMixin, ControlledRunMixin, VerifiedRunMixin,
                                         None if ftv is None else 0))(
             st, s0v, s1v, lpv, ftv)
 
+    def _identity(self) -> Optional[WorldIdentity]:
+        """The fleet's per-world identity operand (batched.py
+        ``WorldIdentity``): what the drivers thread through ``jit``
+        as traced device arrays. ``None`` solo — the solo jaxpr is
+        unchanged (the zero-overhead-off pin)."""
+        if self.batch is None:
+            return None
+        return WorldIdentity(self._s0v, self._s1v, dict(self._lpv),
+                             self._ftv)
+
     def _step_all(self, st, with_trace: bool):
-        """One driver step: the solo superstep, or the vmapped fleet."""
+        """One driver step: the solo superstep, or the vmapped fleet.
+        The fleet's world context comes from the driver-bound operand
+        (``self._ident_in``), falling back to the constructor's host
+        values when stepped outside a driver (trace-equivalent: the
+        fallback holds the same arrays the operand carries)."""
         if self.batch is None:
             return self._superstep(st, with_trace)
-        return self._vstep(st, self._s0v, self._s1v, self._lpv,
-                           self._ftv, with_trace)
+        ident = self._ident_in
+        if ident is None:
+            ident = self._identity()
+        return self._vstep(st, ident.s0v, ident.s1v, ident.lpv,
+                           ident.ftv, with_trace)
+
+    def rebind_identity(self, batch: BatchSpec, faults=None) -> bool:
+        """Swap this fleet's per-world identity IN PLACE — new seeds,
+        link values, and/or fault schedules — without touching the
+        compiled executables. Returns True when the new identity is
+        *shape-compatible* (same B, same link-parameter paths/dtypes,
+        fault tables absent on both sides or of identical padded
+        shape with identical static gates): the jit caches key on
+        this instance plus operand shapes, both unchanged, so the
+        next run re-invokes the SAME executable with new device
+        arrays — the serving layer's zero-recompile admission path
+        (serve/worker.py). Returns False when the identity needs a
+        different executable (world count, link-parameter structure,
+        fault-table shape, or the ``has_skew``/``has_reset``/
+        ``n_restarts`` trace gates changed) — the caller rebuilds.
+
+        Raises ``ValueError`` for identities no engine of this shape
+        could legally run (a window wider than the new fleet's link
+        floor) — the same refusal ``__init__`` makes."""
+        if self.batch is None:
+            raise ValueError(
+                "rebind_identity swaps a fleet's per-world identity; "
+                "a solo engine has none (batch=BatchSpec)")
+        if not isinstance(batch, BatchSpec):
+            raise ValueError(
+                f"batch must be a BatchSpec, got {batch!r}")
+        if batch.B != self.batch.B:
+            return False
+        old_lp = self.batch.link_params or {}
+        new_lp = batch.link_params or {}
+        if set(old_lp) != set(new_lp):
+            return False
+        if any(np.asarray(new_lp[k]).dtype != np.asarray(old_lp[k]).dtype
+               for k in new_lp):
+            return False
+        from ...faults.schedule import as_fleet
+        fleet = None if faults is None else as_fleet(faults, batch.B)
+        if (fleet is None) != (self.faults is None):
+            return False
+        tables = None
+        if fleet is not None:
+            if (fleet.has_skew, fleet.has_reset, fleet.n_restarts) != \
+                    (self._has_skew, self._has_reset,
+                     self._n_restarts):
+                return False
+            tables = fleet.tables(self.scenario.n_nodes)
+            if any(np.asarray(getattr(tables, f)).shape
+                   != tuple(getattr(self._ftv, f).shape)
+                   for f in type(tables)._fields):
+                return False
+        # window re-validation against the NEW fleet's link floor —
+        # the same precondition __init__ enforces, phrased for the
+        # rebind venue. Speculating engines validate their
+        # conservative floor (the bound is dynamically checked).
+        world_links = [batch.world_link(self.link, b)
+                       for b in range(batch.B)]
+        link_floor = min(lk.min_delay_us for lk in world_links)
+        if fleet is not None and (
+                (self.controller is None and self.speculate == "off")
+                or not self._dyn_ok):
+            link_floor = fleet.min_delay_floor(link_floor)
+        floor_ref = (self.spec_floor if self.speculate != "off"
+                     else self.window)
+        if floor_ref > 1 and floor_ref > link_floor:
+            raise ValueError(
+                f"rebind_identity: window={floor_ref} µs exceeds the "
+                f"new fleet's declared min_delay_us={link_floor} (min "
+                "over the batch worlds, fault-degraded where the "
+                "engine has no dynamic clamp); windowed supersteps "
+                "would reorder causally dependent events — this "
+                "identity needs its own bucket (engine.py windowed-"
+                "execution precondition)")
+        # commit: identity attrs only — shapes/dtypes proved equal
+        self.batch = batch
+        sw = [seed_words(s) for s in batch.seeds]
+        self._s0v = jnp.asarray([a for a, _ in sw], jnp.uint32)
+        self._s1v = jnp.asarray([b for _, b in sw], jnp.uint32)
+        self._lpv = {k: jnp.asarray(v) for k, v in new_lp.items()}
+        self._world_links = world_links
+        if fleet is not None:
+            from ...analysis import check_faults
+            self.fault_lint_report = check_faults(
+                fleet, self.scenario, self.lint,
+                who=type(self).__name__)
+            self.faults = fleet
+            self._ftv = type(tables)(*(jnp.asarray(x)
+                                       for x in tables))
+        return True
 
     def _any_world(self, x):
         """Whether any world (on any device) is still active — the
@@ -1956,7 +2067,7 @@ class JaxEngine(RunStatsMixin, ControlledRunMixin, VerifiedRunMixin,
 
     @partial(jax.jit, static_argnums=(0, 2))
     def _run_scan(self, st: EngineState, n_pad: int, max_steps,
-                  dyn=None):
+                  dyn=None, ident=None):
         """Traced driver: ``n_pad`` (static) is the pow2-padded scan
         length (common.py ``scan_pad``), ``max_steps`` (traced) the
         real budget — the shared ``padded_scan`` body computes and
@@ -1965,12 +2076,18 @@ class JaxEngine(RunStatsMixin, ControlledRunMixin, VerifiedRunMixin,
         the controller's knob operand: bound onto ``self`` for the one
         trace this jit performs, so the scan body reads the traced
         scalars — new knob values re-invoke the SAME executable (the
-        no-retrace-in-the-hot-loop contract, controlled.py)."""
+        no-retrace-in-the-hot-loop contract, controlled.py). ``ident``
+        (traced ``WorldIdentity``, or None solo) is the fleet's
+        per-world identity operand, bound the same way — admissions
+        swap seeds/link values/fault tables without a retrace (the
+        serving layer's zero-recompile contract, docs/serving.md)."""
         self._dyn = dyn
+        self._ident_in = ident
         try:
             return padded_scan(self._step_all, st, n_pad, max_steps)
         finally:
             self._dyn = None
+            self._ident_in = None
 
     def _decode_traces(self, ys) -> list:
         """Per-world trace decode of batched scan output ([T, B]
@@ -2036,7 +2153,8 @@ class JaxEngine(RunStatsMixin, ControlledRunMixin, VerifiedRunMixin,
         # (integrity/runner.py): still a pow2 (the masked tail keeps
         # results bit-identical), but a DIFFERENT compiled executable
         final, ys = self._run_scan(
-            st, _scan_pad(top) * self._pad_mult, budget, _dyn)
+            st, _scan_pad(top) * self._pad_mult, budget, _dyn,
+            self._identity())
         ys = jax.device_get(ys)
         self._stats_end(begin, st.steps, final.steps)
         self._capture_telemetry(ys)
@@ -2063,14 +2181,20 @@ class JaxEngine(RunStatsMixin, ControlledRunMixin, VerifiedRunMixin,
                       carry.time + mmin.astype(jnp.int64)))
 
     @partial(jax.jit, static_argnums=(0,))
-    def _run_while(self, st: EngineState, max_steps) -> EngineState:
+    def _run_while(self, st: EngineState, max_steps,
+                   ident=None) -> EngineState:
         # max_steps is traced (a device scalar), so benchmarking with
-        # different budgets reuses one compiled executable
+        # different budgets reuses one compiled executable; `ident`
+        # is the fleet identity operand, bound like _run_scan's
         start_steps = st.steps  # max_steps is per-call, same as run()
         max_steps = jnp.asarray(max_steps, jnp.int64)
-        return jax.lax.while_loop(
-            self._while_cond_fn(start_steps, max_steps),
-            self._while_body_fn(start_steps, max_steps), st)
+        self._ident_in = ident
+        try:
+            return jax.lax.while_loop(
+                self._while_cond_fn(start_steps, max_steps),
+                self._while_body_fn(start_steps, max_steps), st)
+        finally:
+            self._ident_in = None
 
     def run_quiet(self, max_steps,
                   state: Optional[EngineState] = None) -> EngineState:
@@ -2082,7 +2206,7 @@ class JaxEngine(RunStatsMixin, ControlledRunMixin, VerifiedRunMixin,
         st = state if state is not None else self.init_state()
         budget, _ = self._coerce_budget(max_steps)
         begin = self._stats_begin()
-        final = self._run_while(st, budget)
+        final = self._run_while(st, budget, self._identity())
         self._stats_end(begin, st.steps, final.steps)
         if self.verify != "off":
             # never silently unverified: the quiet driver has no

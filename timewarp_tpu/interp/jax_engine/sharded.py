@@ -273,18 +273,23 @@ class ShardedBatchedEngine(ShardedDriver, JaxEngine):
 
     def _step_all(self, st, with_trace: bool):
         # this device's slice of the world context (seed words + link
-        # parameter vectors): closure constants are replicated into
-        # the shard_map body, so slice by mesh position — the same
-        # pattern as MeshComm.local_rows
+        # parameter vectors + fault tables): the identity arrives as
+        # the driver-bound replicated operand (engine.py WorldIdentity
+        # — traced, never a closure constant, so an identity swap is
+        # zero-recompile here too), sliced by mesh position — the
+        # same pattern as MeshComm.local_rows
+        ident = self._ident_in
+        if ident is None:
+            ident = self._identity()
         Bl = self.worlds_local
         off = jax.lax.axis_index(self.axis).astype(jnp.int32) \
             * jnp.int32(Bl)
         def sl(v):
             return jax.lax.dynamic_slice_in_dim(v, off, Bl, axis=0)
-        ftv = None if self._ftv is None else \
-            jax.tree.map(sl, self._ftv)
-        return self._vstep(st, sl(self._s0v), sl(self._s1v),
-                           {k: sl(v) for k, v in self._lpv.items()},
+        ftv = None if ident.ftv is None else \
+            jax.tree.map(sl, ident.ftv)
+        return self._vstep(st, sl(ident.s0v), sl(ident.s1v),
+                           {k: sl(v) for k, v in ident.lpv.items()},
                            ftv, with_trace)
 
     def _any_world(self, x):

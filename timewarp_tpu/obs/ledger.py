@@ -284,8 +284,12 @@ class RunLedger:
         }
         # the comparable measurements ride the index line itself:
         # the rate (median-of-reps, with min/max bands when --reps
-        # ran) and the smoke wall seconds
-        for f in ("value", "min", "max", "reps", "seconds"):
+        # ran), the smoke wall seconds, and the serving layer's
+        # admission throughput (bench.py serve_gossip — gateable now
+        # that its causal explanation, the engine_builds/compiles
+        # counters, rides the same line)
+        for f in ("value", "min", "max", "reps", "seconds",
+                  "admit_per_s"):
             if isinstance(line.get(f), (int, float)) \
                     and not isinstance(line.get(f), bool):
                 rec[f] = line[f]

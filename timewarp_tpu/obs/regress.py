@@ -59,7 +59,11 @@ __all__ = ["Delta", "Anomaly", "CompareReport", "compare_runs",
 
 #: metric field -> (better direction, default gate attr)
 _METRICS = {"value": ("higher", "rate_gate"),
-            "seconds": ("lower", "wall_gate")}
+            "seconds": ("lower", "wall_gate"),
+            # serving admission throughput (bench.py serve_gossip):
+            # present only on serve lines; _compare_one skips metrics
+            # missing on either side, so every other config is inert
+            "admit_per_s": ("higher", "rate_gate")}
 
 
 @dataclass

@@ -229,6 +229,12 @@ class SweepWatch:
                 u.get("budget_efficiency", 1.0)
                 for u in util.values())
             parts.append(f"util eff {eff:.2f}")
+            builds = sum(u.get("engine_builds", 0)
+                         for u in util.values())
+            if builds:
+                # the zero-recompile serving law's live face: builds
+                # should track bucket count, never admission count
+                parts.append(f"engine builds {builds}")
         if w["metrics_kinds"]:
             parts.append(
                 f"metrics {sum(w['metrics_kinds'].values())} lines")

@@ -191,14 +191,20 @@ class ShardedDriver:
         return P()
 
     @partial(jax.jit, static_argnums=(0, 2))
-    def _run_scan(self, st, n_pad: int, max_steps, dyn=None):
+    def _run_scan(self, st, n_pad: int, max_steps, dyn=None,
+                  ident=None):
         # pow2-padded scan length + masked tail, the shared
         # compile-reuse contract (jax_engine/common.py padded_scan).
         # `dyn` is the dispatch controller's traced knob operand
         # (jax_engine/controlled.py) — replicated scalars, bound onto
         # `self` inside the shard_map body exactly like the local
         # driver binds them, so one superstep implementation reads
-        # them in both venues
+        # them in both venues. `ident` is the world-sharded fleet's
+        # per-world identity operand (jax_engine/batched.py
+        # WorldIdentity) — replicated [B] arrays, bound the same way;
+        # _step_all slices this device's worlds by mesh position.
+        # Node-sharded engines pass None (an empty pytree: the
+        # operand list is unchanged, so their jaxprs are too).
         specs = self._state_specs(st)
         # per-world budget vectors on the WORLD-sharded engine: the
         # replicated [B] budget must mask this device's local world
@@ -215,37 +221,39 @@ class ShardedDriver:
                 * jnp.int32(Bl)
             return jax.lax.dynamic_slice_in_dim(ms, off, Bl, 0)
 
-        if dyn is None:
-            def body(s, ms):
-                return padded_scan(self._step_all, s, n_pad,
-                                   local_ms(ms))
-
-            return _smap(body, self.mesh, (specs, P()),
-                         (specs, self._trace_spec()))(st, max_steps)
-
         dyn_specs = jax.tree.map(lambda _: P(), dyn)
+        ident_specs = jax.tree.map(lambda _: P(), ident)
 
-        def body_dyn(s, ms, dy):
+        def body(s, ms, dy, idn):
             self._dyn = dy
+            self._ident_in = idn
             try:
                 return padded_scan(self._step_all, s, n_pad,
                                    local_ms(ms))
             finally:
                 self._dyn = None
+                self._ident_in = None
 
-        return _smap(body_dyn, self.mesh, (specs, P(), dyn_specs),
-                     (specs, self._trace_spec()))(st, max_steps, dyn)
+        return _smap(body, self.mesh,
+                     (specs, P(), dyn_specs, ident_specs),
+                     (specs, self._trace_spec()))(
+            st, max_steps, dyn, ident)
 
     @partial(jax.jit, static_argnums=(0,))
-    def _run_while(self, st, max_steps):
+    def _run_while(self, st, max_steps, ident=None):
         specs = self._state_specs(st)
         max_steps = jnp.asarray(max_steps, jnp.int64)
+        ident_specs = jax.tree.map(lambda _: P(), ident)
 
-        def body_fn(s, ms):
-            start_steps = s.steps
-            return jax.lax.while_loop(
-                self._while_cond_fn(start_steps, ms),
-                self._while_body_fn(start_steps, ms), s)
+        def body_fn(s, ms, idn):
+            self._ident_in = idn
+            try:
+                start_steps = s.steps
+                return jax.lax.while_loop(
+                    self._while_cond_fn(start_steps, ms),
+                    self._while_body_fn(start_steps, ms), s)
+            finally:
+                self._ident_in = None
 
-        return _smap(body_fn, self.mesh, (specs, P()),
-                     specs)(st, max_steps)
+        return _smap(body_fn, self.mesh, (specs, P(), ident_specs),
+                     specs)(st, max_steps, ident)

@@ -116,11 +116,14 @@ request(response=ServeDrained)(ServeDrain)
 
 def bucket_key_sha(cfg: RunConfig) -> str:
     """The open-bucket identity: same family/params/link-structure/
-    resolved-window configs share a batched executable — exactly the
-    sweep bucketer's key (sweep/bucket.py), hashed so it can ride a
-    journal record."""
+    resolved-window/speculate configs share a batched executable —
+    exactly the sweep bucketer's key (sweep/bucket.py), hashed so it
+    can ride a journal record. The key is pure *shape* plus the
+    per-bucket decision-source mode: per-world identity (seed, link
+    values, fault tables) rides the executable as traced operands and
+    never splits a bucket (docs/serving.md)."""
     key = (cfg.family, cfg.params, link_signature(cfg.parse_link()),
-           resolve_window(cfg))
+           resolve_window(cfg), cfg.speculate)
     return hashlib.sha256(repr(key).encode()).hexdigest()[:16]
 
 
@@ -206,12 +209,13 @@ class ServeFrontend:
             cfg = RunConfig.from_json(d, 0)
         except SweepConfigError as e:
             raise ServeRejected(str(e)) from None
-        if cfg.controller != "off" or cfg.speculate != "off":
+        if cfg.controller != "off":
             raise ServeRejected(
                 f"config {cfg.run_id!r}: the serving layer admits "
-                "static-dispatch configs; controller/speculate packs "
-                "run through `timewarp-tpu sweep run` "
-                "(docs/serving.md)")
+                "static-dispatch and speculate configs; controller "
+                "packs run through `timewarp-tpu sweep run` — the "
+                "telemetry controller's per-bucket decision source "
+                "assumes a fixed fleet (docs/serving.md)")
         prev = self._admitted.get(cfg.run_id)
         if prev is not None:
             if prev.get("config") == cfg.to_json():

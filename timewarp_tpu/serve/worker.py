@@ -10,13 +10,18 @@ only through per-world entropy). That is the whole admission trick:
 
 - **admitting** a config into a free slot between chunks needs NO
   state splice — the slot is already bit-identical to the admitted
-  config's solo start. The engine is rebuilt with the slot's real
-  seed / sweepable link values / fault schedule (engine constants are
-  baked per build; mutating them in place would silently reuse the
-  stale jit cache), the running worlds' states carry over unchanged,
-  and the new world's budget turns on. By the batch exactness law,
-  every world — old and new — continues bit-identical to its solo
-  run.
+  config's solo start. Per-world identity (seed words, sweepable
+  link values, fault tables) rides the compiled executable as
+  TRACED OPERANDS (``WorldIdentity``, interp/jax_engine/batched.py),
+  so admission is an on-device operand write: recompute the slot's
+  identity rows from the member table, ``rebind_identity`` them onto
+  the SAME engine instance, and flip the world's budget on — zero
+  rebuilds, zero recompiles (the zero-recompile law,
+  tests/test_zzzzzzzzzzoperand.py). A full ``_build`` survives only
+  for the first chunk and for fault-pad growth, the one admission
+  shape that changes the operand *shapes* rather than their values.
+  By the batch exactness law, every world — old and new — continues
+  bit-identical to its solo run.
 - **fault-pad growth**: an admitted faulted config may need more
   fault-table rows than the bucket realized so far; the rebuilt fleet
   pads every world up, and the in-flight state's ``restart_done``
@@ -35,13 +40,19 @@ The runner is the serving analogue of ``sweep/runner.BucketRunner``
 (chunk loop, digest chains, streamed ``world_done``, atomic
 checkpoints) minus supervision-retry machinery — across hosts the
 lease steal IS the retry — plus the mutable member table. Controller
-and speculate configs are refused at admission (frontend.py): their
-per-bucket decision sources assume a fixed fleet.
+configs are still refused at admission (frontend.py): the telemetry
+controller's decision source assumes a fixed fleet. Speculate
+configs ARE admitted — the bucket owns one persistent
+:class:`~timewarp_tpu.speculate.policy.SpeculationPolicy`, drives
+chunks through ``run_speculative`` (masked per-world rollback), and
+each slot accumulates its OWN committed decision chain
+(``spec_chains``; ``last_run_decisions_world``), which is what keeps
+per-world replay/audit well-defined when a masked rollback gives
+violating worlds a different chunk granularity than clean ones.
 """
 
 from __future__ import annotations
 
-import json
 from typing import Callable, Dict, List, Optional
 
 import numpy as np
@@ -54,13 +65,19 @@ __all__ = ["OpenBucketRunner", "checkpoint_meta"]
 
 
 def checkpoint_meta(path: str) -> Optional[dict]:
-    """Read just the meta block of a ``save_state`` checkpoint (the
-    full verified read happens at load)."""
+    """Read just the meta block of a ``save_state`` checkpoint —
+    through the same ``_read_verified`` discipline as a full load
+    (every leaf sha checked), because this meta STEERS repack and
+    resume (member table, digests, fault pad): a torn checkpoint
+    must fail here, loudly, not as a mis-shaped restore or a wrong
+    repack three moves later (the at-rest half of the integrity
+    detection law, utils/checkpoint.py)."""
     import os
     if not os.path.exists(path):
         return None
-    with np.load(path) as z:
-        return json.loads(bytes(z["__meta__"].tobytes()).decode())
+    from ..utils.checkpoint import _read_verified
+    _, _, meta = _read_verified(path)
+    return meta
 
 
 def _grow_restart(state, new_c: int):
@@ -113,9 +130,20 @@ class OpenBucketRunner:
         #: pending repack splices: slot -> (state_slice, digest,
         #: supersteps, trail), applied at the next rebuild
         self._splices: Dict[int, tuple] = {}
+        #: per-slot COMMITTED speculation decision chains (JSON
+        #: records) — the per-world replay/audit surface under masked
+        #: rollback (module docstring); [] for non-speculating buckets
+        self.spec_chains: List[list] = [[] for _ in range(capacity)]
+        #: the bucket's persistent speculation decision source —
+        #: survives admissions/rebinds so the ladder's committed-chain
+        #: state carries across chunks; rebuilt from checkpointed
+        #: decisions on restore
+        self._spec_policy = None
+        self._util_logged = -1
         self.util = {"chunks": 0, "world_supersteps": 0,
                      "scan_supersteps": 0, "pad_supersteps": 0,
-                     "active_world_chunks": 0}
+                     "active_world_chunks": 0,
+                     "engine_builds": 0, "compiles": 0}
 
     # -- membership --------------------------------------------------------
 
@@ -142,13 +170,15 @@ class OpenBucketRunner:
         self._dirty = True
 
     def splice_in(self, slot: int, cfg: RunConfig, state_slice,
-                  digest: str, supersteps: int, trail: list) -> None:
+                  digest: str, supersteps: int, trail: list,
+                  spec_chain: list = ()) -> None:
         """Repack target side: admit a PARTIALLY-RUN world (its state
         slice and digest bookkeeping move with it) into a free slot."""
         self.admit(slot, cfg)
         self.digests[slot] = digest
         self.supersteps[slot] = int(supersteps)
         self.trails[slot] = list(trail)
+        self.spec_chains[slot] = list(spec_chain)
         self._splices[slot] = (state_slice,)
 
     def world_state_slice(self, b: int):
@@ -166,16 +196,17 @@ class OpenBucketRunner:
                 max(len(s.link_windows) for s in scheds))
         return tuple(max(a, b) for a, b in zip(need, self.min_pad))
 
-    def _build(self):
-        """One batched engine over the CURRENT member table
-        (placeholder slots borrow member-0's link structure and an
-        empty fault schedule; they never step, so their constants are
-        inert). Mirrors sweep/bucket.build_bucket_engine."""
+    def _identity_parts(self):
+        """``(spec, links, fleet, pad, cfg0)`` over the CURRENT
+        member table — the bucket's per-world identity, computed
+        separately from engine construction so :meth:`_rebuild` can
+        try a zero-recompile ``rebind_identity`` before paying a
+        build. Placeholder slots borrow member-0's link structure and
+        an empty fault schedule; they never step, so their identity
+        rows are inert."""
         from ..faults.schedule import FaultFleet, FaultSchedule
         from ..interp.jax_engine.batched import BatchSpec
-        from ..interp.jax_engine.engine import JaxEngine
         cfg0 = next(m for m in self.members if m is not None)
-        sc = build_scenario(cfg0.family, cfg0.params)
         links = [(m or cfg0).parse_link() for m in self.members]
         rows = [link_sweep_params(lk) for lk in links]
         link_params = {path: np.asarray([r[path] for r in rows])
@@ -186,7 +217,6 @@ class OpenBucketRunner:
         scheds = [(m.parse_faults() or FaultSchedule(())) if m
                   else FaultSchedule(()) for m in self.members]
         pad = self._fault_pad(scheds)
-        self.min_pad = pad
         empty = all(not s.events for s in scheds)
         if empty and pad == (0, 0, 0):
             fleet = None
@@ -196,14 +226,34 @@ class OpenBucketRunner:
                 max(pad[1], len(scheds[0].partitions)),
                 max(pad[2], len(scheds[0].link_windows)))
             fleet = FaultFleet(tuple(scheds))
+        return spec, links, fleet, pad, cfg0
+
+    def _build(self, spec, links, fleet, cfg0):
+        """One batched engine over the given identity. Mirrors
+        sweep/bucket.build_bucket_engine; the bucket key guarantees
+        every member shares ``speculate`` (and family/params/link
+        structure/window), so member-0's mode is the bucket's."""
+        from ..interp.jax_engine.engine import JaxEngine
+        sc = build_scenario(cfg0.family, cfg0.params)
         eng = JaxEngine(sc, links[0], window=self.window, batch=spec,
                         faults=fleet, lint=self.lint,
-                        telemetry=self.telemetry)
+                        telemetry=self.telemetry,
+                        speculate=cfg0.speculate)
         eng.metrics_label = f"bucket:{self.bucket_id}"
         return eng
 
     def _rebuild(self) -> None:
-        self.engine = self._build()
+        spec, links, fleet, pad, cfg0 = self._identity_parts()
+        if not (self.engine is not None and pad == self.min_pad
+                and self.engine.rebind_identity(spec, faults=fleet)):
+            # first build, fault-pad growth, or a structural identity
+            # change (fleet presence / static fault gates): the only
+            # paths that still construct — and possibly compile — a
+            # new executable. Everything else re-enters the SAME
+            # executable with new operand rows.
+            self.min_pad = pad
+            self.engine = self._build(spec, links, fleet, cfg0)
+            self.util["engine_builds"] += 1
         init = self.engine.init_state()
         if self.state is None:
             self.state = init
@@ -266,14 +316,27 @@ class OpenBucketRunner:
         self.state = _grow_restart(st, cur_c)
         by_rid = {m.run_id: i for i, m in enumerate(self.members)
                   if m is not None}
-        for rid, d, s, t in zip(meta["members"], meta["digests"],
-                                meta["supersteps"], meta["trail"]):
+        chains = meta.get("spec_chains") \
+            or [[] for _ in meta["members"]]
+        for rid, d, s, t, sc in zip(meta["members"], meta["digests"],
+                                    meta["supersteps"], meta["trail"],
+                                    chains):
             if rid and rid in by_rid:
                 i = by_rid[rid]
                 self.digests[i] = d
                 self.supersteps[i] = int(s)
                 self.trails[i] = [list(x) for x in t]
+                self.spec_chains[i] = [dict(x) for x in sc]
         self.chunks = int(meta.get("chunks", 0))
+        if meta.get("spec_decisions") \
+                and self.engine.speculate != "off":
+            # resume the policy's committed chain where the killed
+            # host left it — fresh decisions continue the ladder
+            # (chunk numbering included) instead of restarting it
+            from ..speculate.policy import SpeculationPolicy
+            self._spec_policy = SpeculationPolicy(
+                self.engine.speculate, fixed_w=self.engine._spec_w,
+                chunk=self.chunk, replay=meta["spec_decisions"])
         self.emitted = set(self.done)
 
     def step(self) -> str:
@@ -293,22 +356,51 @@ class OpenBucketRunner:
                 continue
             res = world_result(cfg, st, int(b), self.digests[int(b)],
                                self.supersteps[int(b)])
-            self._commit({"ev": "world_done",
-                          "bucket": self.bucket_id,
-                          "wall_s": round(self.wall_s, 6),
-                          "attempts": 1,
-                          "chain": self.trails[int(b)],
-                          "result": res})
+            rec = {"ev": "world_done",
+                   "bucket": self.bucket_id,
+                   "wall_s": round(self.wall_s, 6),
+                   "attempts": 1,
+                   "chain": self.trails[int(b)],
+                   "result": res}
+            if eng.speculate != "off":
+                # the world's own committed decision chain — a solo
+                # verify twin replays exactly this (per-slot chains,
+                # module docstring); a sibling of "chain", NOT part of
+                # "result", so the survival-law compare surface is
+                # untouched
+                rec["spec_chain"] = list(self.spec_chains[int(b)])
+            self._commit(rec)
             self.done[cfg.run_id] = res
             self.emitted.add(cfg.run_id)
         if not active.any():
+            if self.util["chunks"] and self.chunks != self._util_logged:
+                # journal utilization at the running->idle edge (the
+                # sweep's analogue journals at bucket completion);
+                # last-record-wins in the fold, so re-idling after
+                # more admissions just refreshes the numbers
+                self._commit({"ev": "bucket_util",
+                              **self.utilization()})
+                self._util_logged = self.chunks
             return "idle"
         vec = np.where(active, np.minimum(remaining, self.chunk), 0)
         import time as _time
 
         from ..interp.jax_engine.common import scan_pad
         t0 = _time.perf_counter()
-        new_state, traces = eng.run(vec, state=st)
+        if eng.speculate != "off":
+            if self._spec_policy is None:
+                from ..speculate.policy import SpeculationPolicy
+                self._spec_policy = SpeculationPolicy(
+                    eng.speculate, fixed_w=eng._spec_w,
+                    chunk=self.chunk)
+            new_state, traces = eng.run_speculative(
+                vec, state=st, chunk=self.chunk,
+                policy=self._spec_policy)
+            for b, chain in enumerate(
+                    eng.last_run_decisions_world or []):
+                self.spec_chains[b].extend(d.to_json() for d in chain)
+        else:
+            new_state, traces = eng.run(vec, state=st)
         self.wall_s += _time.perf_counter() - t0
         for b in range(B):
             if len(traces[b]):
@@ -326,6 +418,8 @@ class OpenBucketRunner:
         u["scan_supersteps"] += scan_pad(top)
         u["pad_supersteps"] += scan_pad(top) - top
         u["active_world_chunks"] += int(active.sum())
+        u["compiles"] += int((eng.last_run_stats or {}
+                              ).get("compiles", 0))
         from ..utils.checkpoint import save_state
         if self.precommit is not None:
             self.precommit()
@@ -338,7 +432,14 @@ class OpenBucketRunner:
                                         for s in self.supersteps],
                          "trail": [list(t) for t in self.trails],
                          "chunks": self.chunks,
-                         "fault_pad": list(self.min_pad)})
+                         "fault_pad": list(self.min_pad),
+                         "spec_chains": [list(c)
+                                         for c in self.spec_chains],
+                         "spec_decisions": (
+                             [d.to_json() for d in
+                              self._spec_policy.decisions]
+                             if self._spec_policy is not None
+                             else [])})
         return "running"
 
     def utilization(self) -> dict:
@@ -365,6 +466,8 @@ class OpenBucketRunner:
             "worlds_active_mean": round(
                 u["active_world_chunks"] / (u["chunks"] * B), 4)
             if u["chunks"] else 0.0,
+            "engine_builds": u["engine_builds"],
+            "compiles": u["compiles"],
             "wall_s": round(self.wall_s, 6),
         }
 
@@ -399,6 +502,6 @@ class OpenBucketRunner:
             cfg = donor.members[b]
             self.splice_in(slot, cfg, donor.world_state_slice(b),
                            donor.digests[b], donor.supersteps[b],
-                           donor.trails[b])
+                           donor.trails[b], donor.spec_chains[b])
             moved.append(cfg.run_id)
         return moved

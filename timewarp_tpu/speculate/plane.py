@@ -38,8 +38,8 @@ import numpy as np
 
 __all__ = ["SPECULATE_MODES", "SPECULATE_GRAMMAR", "SpecRow",
            "SpeculationViolation", "parse_speculate",
-           "first_spec_violation", "spec_violation_error",
-           "hit_scalars"]
+           "first_spec_violation", "world_spec_violations",
+           "spec_violation_error", "hit_scalars"]
 
 #: the engine knob's legal value shapes
 SPECULATE_MODES = ("off", "auto", "fixed")
@@ -106,13 +106,10 @@ class SpecRow(NamedTuple):
     #                 # µs; NEVER when clean)
 
 
-def first_spec_violation(spec, valid, t_us,
-                         n_worlds: Optional[int] = None
-                         ) -> Optional[dict]:
-    """Host-side decode of a traced run's stacked spec rows ([T]
-    leaves; [T, B] batched): the FIRST violating superstep (earliest
-    index, then world), or None when the run is clean. Zeroed
-    padded-scan/quiesced rows can never flag (violations == 0)."""
+def _scan_worlds(spec, valid, t_us):
+    """The shared per-world scanner behind both decodes: a closure
+    mapping a world index (None = solo) to its first violating
+    superstep's hit dict, or None when that world is clean."""
     valid = np.asarray(valid)
     t_us = np.asarray(t_us)
     viol = np.asarray(spec.violations)
@@ -136,10 +133,31 @@ def first_spec_violation(spec, valid, t_us,
         return {"superstep": i, "t": at(t_us), "world": world,
                 "count": int(v[si]), "horizon": at(hor),
                 "straggler": at(strag)}
+    return scan_world
 
+
+def world_spec_violations(spec, valid, t_us, n_worlds: int) -> list:
+    """Per-world decode of a batched run's spec plane ([T, B]
+    leaves): a length-``n_worlds`` list holding each world's first
+    violating superstep's hit dict, or ``None`` for clean worlds —
+    the mask the masked re-run driver (runner.py) re-runs only the
+    violating worlds from, preserving every clean world's committed
+    progress."""
+    scan_world = _scan_worlds(spec, valid, t_us)
+    return [scan_world(b) for b in range(n_worlds)]
+
+
+def first_spec_violation(spec, valid, t_us,
+                         n_worlds: Optional[int] = None
+                         ) -> Optional[dict]:
+    """Host-side decode of a traced run's stacked spec rows ([T]
+    leaves; [T, B] batched): the FIRST violating superstep (earliest
+    index, then world), or None when the run is clean. Zeroed
+    padded-scan/quiesced rows can never flag (violations == 0)."""
     if n_worlds is None:
-        return scan_world(None)
-    hits = [h for h in (scan_world(b) for b in range(n_worlds)) if h]
+        return _scan_worlds(spec, valid, t_us)(None)
+    hits = [h for h in world_spec_violations(spec, valid, t_us,
+                                             n_worlds) if h]
     if not hits:
         return None
     return min(hits, key=lambda h: (h["superstep"], h["world"]))

@@ -4,7 +4,15 @@ A bucket is the largest set of pack configs one batched engine can
 serve (engine.py ``batch=BatchSpec``): same scenario family and
 builder params (one ``Scenario``, one compiled superstep), same link
 *structure* (:func:`~timewarp_tpu.sweep.spec.link_signature`), and the
-same solo-resolved window. Inside a bucket, worlds differ by:
+same solo-resolved window. The key is pure **shape** (plus the
+per-bucket decision-source modes, ``_bucket_key``): everything that
+picks *which executable* compiles. Per-world **identity** — seed
+words, sweepable link values, fault tables — rides that executable
+as traced operands (``WorldIdentity``, interp/jax_engine/batched.py)
+and never splits a bucket; swapping identity re-invokes the SAME
+compiled function with new device arrays
+(``JaxEngine.rebind_identity``, the serving layer's zero-recompile
+admission, serve/worker.py). Inside a bucket, worlds differ by:
 
 - **seed** — ``BatchSpec.seeds``;
 - **sweepable link values** — delay bounds / medians / sigmas /
@@ -99,13 +107,19 @@ class Bucket:
 
 
 def _bucket_key(cfg: RunConfig):
-    # controller is part of the bucket's identity: the dispatch
-    # controller makes ONE decision sequence per bucket (journaled;
-    # replayed by every member's solo twin), so controller-on and
-    # controller-off worlds can never share an executable's chunking.
-    # speculate likewise: the speculation policy is a per-bucket
-    # decision source with per-bucket rollbacks (speculate/), so
-    # worlds under different speculate modes can never share one
+    # the bucket key is the executable's SHAPE — scenario family +
+    # params, link structure, resolved window — plus the per-bucket
+    # decision-source modes. Seed / link values / fault schedules are
+    # per-world IDENTITY: traced operands of the shared executable
+    # (module docstring), deliberately absent from the key.
+    # controller is part of the key: the dispatch controller makes
+    # ONE decision sequence per bucket (journaled; replayed by every
+    # member's solo twin), so controller-on and controller-off worlds
+    # can never share an executable's chunking. speculate likewise:
+    # the speculation policy is a per-bucket decision source with
+    # per-bucket rollbacks (speculate/); the serving frontend's
+    # bucket_key_sha mirrors this key (minus controller, refused at
+    # admission there).
     return (cfg.family, cfg.params, link_signature(cfg.parse_link()),
             resolve_window(cfg), cfg.controller, cfg.speculate)
 

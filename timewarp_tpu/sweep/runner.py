@@ -164,7 +164,8 @@ class BucketRunner:
         #: length did real (unmasked, unpadded) supersteps use
         self.util = {"chunks": 0, "world_supersteps": 0,
                      "scan_supersteps": 0, "pad_supersteps": 0,
-                     "active_world_chunks": 0}
+                     "active_world_chunks": 0,
+                     "engine_builds": 0, "compiles": 0}
 
     # -- attempt lifecycle (called from the event-loop thread) -----------
 
@@ -298,6 +299,7 @@ class BucketRunner:
             self._check(epoch)
             if self.engine is None:
                 self.engine = engine
+                self.util["engine_builds"] += 1
                 self.ctrl = ctrl
                 if ctrl is not None:
                     ctrl.begin(engine)
@@ -584,6 +586,8 @@ class BucketRunner:
             u["scan_supersteps"] += scan_pad(top)
             u["pad_supersteps"] += scan_pad(top) - top
             u["active_world_chunks"] += int(active.sum())
+            u["compiles"] += int((eng.last_run_stats or {}
+                                  ).get("compiles", 0))
             from ..utils.checkpoint import save_state
             ckpt_cm = (self.metrics.span(
                 "checkpoint", bucket=self.bucket.bucket_id)
@@ -633,6 +637,8 @@ class BucketRunner:
             "worlds_active_mean": round(
                 u["active_world_chunks"] / (u["chunks"] * B), 4)
             if u["chunks"] else 0.0,
+            "engine_builds": u["engine_builds"],
+            "compiles": u["compiles"],
             "wall_s": round(self.wall_s, 6),
         }
 
