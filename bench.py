@@ -1525,6 +1525,74 @@ def bench_serve_gossip(n, steps):
             len(cfgs) / dt, extra)
 
 
+def bench_lint_sweep(n, steps):
+    """Fleet-scale static verification (analysis/, docs/sweeps.md +
+    docs/serving.md "Pre-flight verification"): time the three pass
+    families a fleet pays BEFORE any engine builds — the scenario
+    sanitizer sweep over every shipped model (the same sweep as this
+    bench's own pre-run gate), the plan lint over every example pack
+    (bucket/width/window prediction, fault-pad rebuild detection,
+    fault-aware capacity proofs), and the jaxpr determinism sweep
+    over every shipped engine x observability mode (TW7xx scans plus
+    the TW705 off-mode neutrality proofs). Gated in-bench both ways:
+    the shipped models, the clean example packs, and the jaxpr sweep
+    must lint ZERO errors, and the doomed example pack must FAIL —
+    the refusal corpus staying refused is as much a contract as the
+    clean corpus staying clean. Reports verified subjects+configs/sec
+    with per-surface second splits on the BENCH_SCHEMA=2 line: the
+    honest price of refuse-before-run at sweep-prepare/admission
+    time."""
+    import glob as globlib
+
+    from timewarp_tpu.analysis import lint_pack_path
+    from timewarp_tpu.cli import jaxpr_sweep, lint_sweep
+
+    n = n or 64
+    here = os.path.dirname(os.path.abspath(__file__))
+    packs = sorted(globlib.glob(
+        os.path.join(here, "examples", "packs", "*.json")))
+    assert packs, "examples/packs/*.json missing"
+    t0 = time.perf_counter()
+    subjects, rep = lint_sweep(nodes=n)
+    assert rep.ok, f"shipped models failed lint:\n{rep.render()}"
+    t1 = time.perf_counter()
+    configs = 0
+    for path in packs:
+        n_entries, prep = lint_pack_path(path)
+        configs += n_entries
+        if os.path.basename(path).startswith("doomed"):
+            assert not prep.ok, (
+                f"{path}: the doomed refusal corpus linted GREEN — "
+                "the refuse-before-run gate has gone blind")
+        else:
+            assert prep.ok, (
+                f"{path}: shipped example pack failed the plan "
+                f"lint:\n{prep.render()}")
+    t2 = time.perf_counter()
+    # abstract tracing: the driver's primitive inventory does not
+    # change with fleet width, so the jaxpr sweep stays at 8 nodes
+    jx_subjects, jx_rep = jaxpr_sweep(nodes=8)
+    assert jx_rep.ok, (
+        f"jaxpr determinism sweep failed:\n{jx_rep.render()}")
+    assert any(f.code == "TW705" for f in jx_rep.infos), \
+        "no TW705 neutrality proofs in the jaxpr sweep"
+    t3 = time.perf_counter()
+    total = subjects + configs + jx_subjects
+    extra = {
+        "lint_subjects": subjects,
+        "pack_files": len(packs),
+        "pack_configs": configs,
+        "jaxpr_subjects": jx_subjects,
+        "sanitizer_s": round(t1 - t0, 2),
+        "plan_s": round(t2 - t1, 2),
+        "jaxpr_s": round(t3 - t2, 2),
+    }
+    return (f"static pre-flight verification (sanitizer + plan lint "
+            f"+ jaxpr determinism sweep, refusal corpus gated) "
+            f"verified subjects/sec @{n} nodes",
+            total / (t3 - t0), extra)
+
+
 CONFIGS = {
     "token_ring_dense": bench_token_ring_dense,
     "token_ring_dense_xla": bench_token_ring_dense_xla,
@@ -1547,6 +1615,7 @@ CONFIGS = {
     "sweep_hetero_auto": bench_sweep_hetero_auto,
     "search_gossip": bench_search_gossip,
     "serve_gossip": bench_serve_gossip,
+    "lint_sweep": bench_lint_sweep,
 }
 
 #: --smoke shapes: every config tiny enough for a CPU CI runner, all
@@ -1574,6 +1643,7 @@ SMOKE = {
     "sweep_hetero_auto": (256, 96),
     "search_gossip": (64, 300),
     "serve_gossip": (256, 96),
+    "lint_sweep": (64, 1),
 }
 
 

@@ -26,7 +26,7 @@ def test_bench_config_runs(cfg):
          "praos_1m_insert": 2048,
          "praos_1m_b4": 512, "sweep_hetero": 256,
          "sweep_hetero_auto": 256, "search_gossip": 64,
-         "serve_gossip": 256}[cfg]
+         "serve_gossip": 256, "lint_sweep": 64}[cfg]
     # the gossip waves run to quiescence and assert they got there;
     # the sweep-service configs take per-world budgets, not a window;
     # the search config's steps are a per-evaluation budget
@@ -73,6 +73,17 @@ def test_bench_config_runs(cfg):
         assert extra["fork_saving_frac"] > 0
         assert extra["minimized"] and extra["minimized_events"] >= 1
         assert extra["evaluations"] > 0
+    if cfg == "lint_sweep":
+        # the static pre-flight verification config: all three pass
+        # families actually swept (subjects counted, never zero), the
+        # doomed refusal corpus stayed refused (the in-config gate
+        # already asserted it), and the per-surface splits are honest
+        assert extra["lint_subjects"] > 0
+        assert extra["jaxpr_subjects"] > 0
+        assert extra["pack_files"] >= 2
+        assert extra["pack_configs"] > extra["pack_files"]
+        assert all(extra[k] >= 0 for k in
+                   ("sanitizer_s", "plan_s", "jaxpr_s"))
     if cfg == "gossip_100k_record":
         # the flight-recorder config reports honest per-mode numbers
         # (obs/flight.py): both modes measured, events recorded, and

@@ -416,3 +416,106 @@ def test_good_host_specs_parse():
     assert [h.name for h in fleet] == ["a", "b", "c.2_x"]
     assert fleet[0].addr == ("10.0.0.1", 7700)
     assert fleet[1].addr is None
+
+
+# -- pack entries (sweep/spec.py PACK_GRAMMAR) ----------------------------
+#
+# a malformed SweepPack/RunConfig JSON dies naming the offending field
+# and quoting PACK_GRAMMAR — never a raw KeyError/TypeError from
+# deeper in the machinery (the LINK_GRAMMAR/FAULT_GRAMMAR discipline)
+
+BAD_PACKS = [
+    "nope",                                    # entry not an object
+    {"params": {"nodes": 8}},                  # missing scenario
+    {"scenario": 42},                          # scenario not a string
+    {"scenario": "warp-drive"},                # unknown family
+    {"scenario": "gossip", "mailbox": 9},      # unknown key
+    {"scenario": "gossip", "params": [8]},     # params not an object
+    {"scenario": "gossip",
+     "params": {"teleport": 1}},               # unknown builder param
+    {"scenario": "gossip", "link": 123},       # link not a string spec
+    {"scenario": "gossip", "seed": "0"},       # seed not an int
+    {"scenario": "gossip", "seed": True},      # bool masquerading
+    {"scenario": "gossip", "window": True},    # bool window (== 1!)
+    {"scenario": "gossip", "window": 0},       # window below range
+    {"scenario": "gossip", "window": "wide"},  # window not int/'auto'
+    {"scenario": "gossip", "budget": 3.5},     # budget not an int
+    {"scenario": "gossip", "budget": 0},       # budget below range
+    {"scenario": "gossip", "faults": ["c"]},   # faults not a string
+    {"scenario": "gossip", "controller": None},   # controller type
+    {"scenario": "gossip", "controller": "maybe"},  # controller value
+    {"scenario": "gossip", "speculate": 2000},    # speculate type
+    {"scenario": "gossip", "speculate": "fixed"},  # missing :W
+    {"scenario": "gossip", "speculate": "auto",
+     "controller": "auto"},                    # two decision sources
+]
+
+
+@pytest.mark.parametrize("entry", BAD_PACKS,
+                         ids=[str(i) for i in range(len(BAD_PACKS))])
+def test_malformed_pack_entries_name_the_field(entry):
+    from timewarp_tpu.sweep.spec import RunConfig, SweepConfigError
+    with pytest.raises(SweepConfigError) as ei:
+        RunConfig.from_json(entry, 0)
+    msg = str(ei.value)
+    assert "0" in msg or "'w0'" in msg, \
+        f"{entry!r} died without naming the entry: {msg}"
+
+
+@pytest.mark.parametrize("entry", BAD_PACKS,
+                         ids=[str(i) for i in range(len(BAD_PACKS))])
+def test_malformed_pack_entries_never_raw_traceback(entry):
+    from timewarp_tpu.sweep.spec import RunConfig, SweepConfigError
+    try:
+        RunConfig.from_json(entry, 0)
+    except SweepConfigError:
+        pass                    # the loud, field-naming species
+    else:
+        pytest.fail(f"{entry!r} parsed without error")
+
+
+def test_malformed_pack_shapes_die_loudly():
+    from timewarp_tpu.sweep.spec import SweepConfigError, SweepPack
+    for data in ("worlds", {"no_worlds": []}, 17):
+        with pytest.raises(SweepConfigError):
+            SweepPack.from_json(data)
+    with pytest.raises(SweepConfigError) as ei:
+        SweepPack.from_json([])            # empty pack
+    assert "at least one" in str(ei.value)
+    dup = [{"scenario": "gossip", "id": "w0"},
+           {"scenario": "gossip", "id": "w0"}]
+    with pytest.raises(SweepConfigError) as ei:
+        SweepPack.from_json(dup)
+    assert "duplicate" in str(ei.value)
+
+
+def test_field_refusals_quote_pack_grammar():
+    from timewarp_tpu.sweep.spec import (PACK_GRAMMAR, RunConfig,
+                                         SweepConfigError)
+    for entry in [{"scenario": "gossip", "params": [8]},
+                  {"scenario": "gossip", "window": True},
+                  {"scenario": "gossip", "link": 123},
+                  {"params": {"nodes": 8}}]:
+        with pytest.raises(SweepConfigError) as ei:
+            RunConfig.from_json(entry, 0)
+        assert PACK_GRAMMAR in str(ei.value), \
+            f"{entry!r} died without quoting PACK_GRAMMAR"
+
+
+def test_good_pack_entries_round_trip():
+    from timewarp_tpu.sweep.spec import RunConfig, SweepPack
+    entries = [
+        {"scenario": "gossip", "params": {"nodes": 8}},
+        {"scenario": "token-ring", "id": "ring",
+         "params": {"nodes": 8, "with_observer": False},
+         "link": "fixed:1000", "seed": 3, "window": "auto",
+         "budget": 50},
+        {"scenario": "praos", "faults": "crash:1:5s:9s:reset",
+         "speculate": "fixed:16000"},
+    ]
+    pack = SweepPack.from_json(entries)
+    again = SweepPack.from_json(pack.to_json())
+    assert again == pack and again.sha() == pack.sha()
+    # every to_json survives its own from_json field-for-field
+    for i, c in enumerate(pack.configs):
+        assert RunConfig.from_json(c.to_json(), i) == c

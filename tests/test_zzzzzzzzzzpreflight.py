@@ -1,0 +1,486 @@
+"""Fleet-scale pre-flight verification: the plan lint (TW6xx,
+analysis/plan_lint.py), the fault-aware capacity proofs
+(TW205/TW206, analysis/capacity.py), the jaxpr determinism sanitizer
+(TW7xx, analysis/determinism.py), and the gates they ride — sweep
+``--lint``, serve admission, and the ``lint``/``lint-pack`` CLIs with
+their pinned JSON schema + exit-code contract."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from timewarp_tpu.analysis import (LintError, lint_capacity_faulted,
+                                   lint_pack_json, lint_run_config,
+                                   lint_scenario, max_delay_us,
+                                   prove_mode_neutrality,
+                                   scan_jaxpr_determinism)
+from timewarp_tpu.core.scenario import NEVER, Outbox, Scenario
+from timewarp_tpu.faults.schedule import parse_faults
+from timewarp_tpu.net.delays import (FixedDelay, LogNormalDelay,
+                                     Quantize, UniformDelay, WithDrop)
+from timewarp_tpu.sweep.spec import RunConfig, SweepConfigError
+
+
+# ----------------------------------------------------------------------
+# fixtures
+# ----------------------------------------------------------------------
+
+def _out(M=1, P=1):
+    return Outbox(valid=jnp.zeros((M,), bool),
+                  dst=jnp.zeros((M,), jnp.int32),
+                  payload=jnp.zeros((M, P), jnp.int32))
+
+
+def _ok_step(state, inbox, now, i, key):
+    return state, _out(), jnp.int64(NEVER)
+
+
+def _mk(step=_ok_step, name="fixture", **kw):
+    kw.setdefault("n_nodes", 4)
+    kw.setdefault("payload_width", 1)
+    kw.setdefault("max_out", 1)
+    kw.setdefault("mailbox_cap", 4)
+    kw.setdefault("init", lambda i: ({"x": jnp.int32(0)}, 0))
+    return Scenario(name=name, step=step, **kw)
+
+
+def _funnel(cap=4):
+    """4 nodes, every outbox slot aimed at node 0: fault-free fan-in
+    is exactly 4, so mailbox_cap=4 passes the single-wave proof with
+    zero headroom — any degrade pileup overflows provably."""
+    return _mk(name="funnel", mailbox_cap=cap,
+               static_dst=np.zeros((4, 1), np.int32))
+
+
+def _cfg(d, i=0):
+    return RunConfig.from_json(d, i)
+
+
+# ----------------------------------------------------------------------
+# plan lint (TW6xx)
+# ----------------------------------------------------------------------
+
+def test_plan_lint_clean_heterogeneous_pack_with_fault_fleets():
+    n, rep = lint_pack_json({"worlds": [
+        {"scenario": "gossip", "params": {"nodes": 16},
+         "link": "fixed:1000"},
+        {"scenario": "gossip", "params": {"nodes": 16},
+         "link": "fixed:1000", "seed": 1,
+         "faults": "crash:3:5s:9s:reset"},
+        {"scenario": "token-ring", "params": {"nodes": 8},
+         "link": "fixed:1000",
+         "faults": "crash:1:5s:9s:reset; partition:0-3|4-7:2s:4s"},
+        {"scenario": "praos", "params": {"nodes": 8},
+         "link": "uniform:1000:5000"},
+    ]})
+    assert n == 4 and rep.ok, rep.render()
+    plan = [f for f in rep.infos if f.code == "TW601"]
+    assert len(plan) == 1
+    # the plan predicts builds, widths, and the fault pads
+    assert "4 world(s)" in plan[0].message
+    assert "engine build(s)" in plan[0].message
+    assert "fault pads" in plan[0].message
+
+
+def test_plan_lint_predicts_bucket_sharing():
+    # same family/params/link/window -> one bucket, fleet width 2
+    world = {"scenario": "gossip", "params": {"nodes": 16},
+             "link": "fixed:1000"}
+    n, rep = lint_pack_json([world, {**world, "id": "twin",
+                                     "seed": 7}])
+    plan = next(f for f in rep.infos if f.code == "TW601")
+    assert "-> 1 bucket(s)" in plan.message
+    assert "fleet widths [2]" in plan.message
+
+
+def test_plan_lint_refuses_controller_times_speculate():
+    # unrepresentable as a parsed RunConfig (__post_init__ refuses),
+    # so the raw-JSON path must carry the refusal as a TW600 finding
+    n, rep = lint_pack_json([
+        {"scenario": "gossip", "params": {"nodes": 16},
+         "controller": "auto", "speculate": "auto"}])
+    assert not rep.ok
+    assert "TW600" in [f.code for f in rep.errors]
+    assert "decision source" in rep.errors[0].message
+
+
+def test_plan_lint_flags_degrade_window_undercut():
+    cfg = _cfg({"scenario": "gossip", "params": {"nodes": 16},
+                "link": "uniform:1000:5000", "window": 900,
+                "faults": "degrade:all:all:1s:2s:0.1:0"})
+    rep = lint_run_config(cfg)
+    tw602 = [f for f in rep.errors if f.code == "TW602"]
+    assert len(tw602) == 1
+    assert "degrades" in tw602[0].message   # names the undercut
+
+
+def test_plan_lint_window_within_floor_is_clean():
+    cfg = _cfg({"scenario": "gossip", "params": {"nodes": 16},
+                "link": "uniform:1000:5000", "window": 1000})
+    assert lint_run_config(cfg).ok
+
+
+def test_plan_lint_flags_doomed_fixed_horizon():
+    # the config resolves window=5000 (fixed link floor); a fixed
+    # speculation horizon at or below it can never speculate
+    cfg = _cfg({"scenario": "gossip", "params": {"nodes": 16},
+                "link": "fixed:5000", "window": "auto",
+                "speculate": "fixed:3000"})
+    rep = lint_run_config(cfg)
+    assert "TW603" in [f.code for f in rep.errors]
+    ok = _cfg({"scenario": "gossip", "params": {"nodes": 16},
+               "link": "fixed:5000", "window": "auto",
+               "speculate": "fixed:16000"})
+    assert lint_run_config(ok).ok
+
+
+def test_plan_lint_flags_pad_growth_rebuild():
+    base = {"scenario": "gossip", "params": {"nodes": 16},
+            "link": "fixed:1000"}
+    n, rep = lint_pack_json([
+        {**base, "id": "a", "faults": "crash:1:5s:9s:reset"},
+        {**base, "id": "b",
+         "faults": "crash:1:5s:9s:reset; crash:2:5s:9s:reset"},
+    ])
+    assert rep.ok                      # a warning, not a refusal
+    tw605 = [f for f in rep.warnings if f.code == "TW605"]
+    assert len(tw605) == 1 and "'b'" in tw605[0].subject
+    assert "REBUILD" in tw605[0].message
+    # front-loading the widest schedule is the documented fix
+    n, rep2 = lint_pack_json([
+        {**base, "id": "b",
+         "faults": "crash:1:5s:9s:reset; crash:2:5s:9s:reset"},
+        {**base, "id": "a", "faults": "crash:1:5s:9s:reset"},
+    ])
+    assert not [f for f in rep2.warnings if f.code == "TW605"]
+
+
+def test_plan_lint_malformed_entries_become_findings():
+    n, rep = lint_pack_json([
+        {"scenario": "gossip", "params": {"nodes": 16},
+         "link": "fixed:1000"},
+        {"scenario": "warp-drive"},
+        "not an object",
+    ])
+    assert n == 3 and not rep.ok
+    codes = [f.code for f in rep.errors]
+    assert codes.count("TW600") == 2
+    # the parseable world still got its plan
+    assert any(f.code == "TW601" for f in rep.infos)
+
+
+def test_plan_lint_bad_file_is_a_finding(tmp_path):
+    from timewarp_tpu.analysis import lint_pack_path
+    p = tmp_path / "pack.json"
+    p.write_text("{not json")
+    n, rep = lint_pack_path(str(p))
+    assert not rep.ok and rep.errors[0].code == "TW600"
+    n, rep = lint_pack_path(str(tmp_path / "absent.json"))
+    assert not rep.ok and "unreadable" in rep.errors[0].message
+
+
+# ----------------------------------------------------------------------
+# fault-aware capacity proofs (TW205/TW206)
+# ----------------------------------------------------------------------
+
+def test_max_delay_us_bounds():
+    assert max_delay_us(FixedDelay(1000)) == 1000
+    assert max_delay_us(UniformDelay(1000, 5000)) == 5000
+    assert max_delay_us(WithDrop(UniformDelay(1000, 5000), 0.1)) \
+        == 5000
+    assert max_delay_us(Quantize(UniformDelay(1000, 5000), 300)) \
+        == 5100                      # rounded UP to the grid
+    assert max_delay_us(
+        LogNormalDelay(2000, 0.5, cap_us=60_000)) == 60_000
+    # a link with no declared bound has no static max
+    class FnDelay:
+        pass
+    assert max_delay_us(FnDelay()) is None
+
+
+def test_faulted_capacity_catches_degrade_pileup():
+    sc = _funnel(cap=4)
+    link = UniformDelay(1000, 5000)
+    sched = parse_faults("degrade:all:all:1s:2s:4.0:0")
+    rep = lint_capacity_faulted(sc, sched, link, 1000)
+    tw205 = [f for f in rep.errors if f.code == "TW205"]
+    assert len(tw205) == 1
+    msg = tw205[0].message
+    # names the violating window and node
+    assert "[1000000, 2000000)" in msg and "node 0" in msg
+
+
+def test_faulted_capacity_proves_safe_schedules():
+    sc = _funnel(cap=4)
+    link = UniformDelay(1000, 5000)
+    # extra_us shifts every delay equally - the spread is unchanged,
+    # no pileup; scale<1 shrinks it
+    for spec in ("degrade:all:all:1s:2s:1.0:10ms",
+                 "degrade:all:all:1s:2s:0.5:0"):
+        rep = lint_capacity_faulted(sc, parse_faults(spec), link, 1000)
+        assert rep.ok, rep.render()
+        assert "TW206" in [f.code for f in rep.infos]
+    # crash/partition-only schedules never grow a wave
+    rep = lint_capacity_faulted(
+        sc, parse_faults("crash:1:5s:9s:reset"), link, 1000)
+    assert rep.ok and not rep.findings   # no degrade window: no proof
+
+
+def test_faulted_capacity_crash_relief():
+    sc = _funnel(cap=4)
+    link = UniformDelay(1000, 5000)
+    # all four senders crashed across the whole degrade window:
+    # nothing is sent into it, so nothing can pile up
+    spec = ("degrade:all:all:1s:2s:4.0:0; "
+            + "; ".join(f"crash:{i}:0s:3s:reset" for i in range(4)))
+    rep = lint_capacity_faulted(sc, parse_faults(spec), link, 1000)
+    assert rep.ok, rep.render()
+
+
+def test_faulted_capacity_partition_relief():
+    # nodes 1-3 funnel onto node 0 (no self-loop); a partition
+    # isolating node 0 from every sender covers the whole degrade
+    # window, so every folded edge is cut - nothing piles up
+    sd = np.array([[-1], [0], [0], [0]], np.int32)
+    sc = _mk(name="cut-funnel", mailbox_cap=3, static_dst=sd)
+    link = UniformDelay(1000, 5000)
+    spec = ("degrade:all:all:1s:2s:4.0:0; "
+            "partition:0|1-3:0s:3s")
+    rep = lint_capacity_faulted(sc, parse_faults(spec), link, 1000)
+    assert rep.ok, rep.render()
+    # without the partition the same degrade provably overflows
+    rep2 = lint_capacity_faulted(
+        sc, parse_faults("degrade:all:all:1s:2s:4.0:0"), link, 1000)
+    assert "TW205" in [f.code for f in rep2.errors]
+
+
+def test_faulted_capacity_rides_run_config_lint():
+    cfg = _cfg({"scenario": "token-ring",
+                "params": {"nodes": 8, "with_observer": False,
+                           "mailbox_cap": 1},
+                "link": "uniform:1000:5000", "window": 1000,
+                "faults": "degrade:all:all:1s:2s:6.0:0"})
+    rep = lint_run_config(cfg)
+    assert "TW205" in [f.code for f in rep.errors]
+
+
+# ----------------------------------------------------------------------
+# determinism sanitizer (TW7xx)
+# ----------------------------------------------------------------------
+
+def test_sanitizer_flags_float_scatter_add():
+    def step(state, inbox, now, i, key):
+        acc = jnp.zeros((4,), jnp.float32)
+        acc = acc.at[inbox.src].add(1.5)      # dup indices possible
+        s = {"x": state["x"] + acc.sum().astype(jnp.int32)}
+        return s, _out(), jnp.int64(NEVER)
+    rep = lint_scenario(_mk(step, inbox_src=True))
+    tw701 = [f for f in rep.errors if f.code == "TW701"]
+    assert len(tw701) == 1 and "scatter-add" in tw701[0].message
+
+
+def test_sanitizer_passes_integer_scatter_add():
+    def step(state, inbox, now, i, key):
+        acc = jnp.zeros((4,), jnp.int32).at[inbox.src].add(1)
+        s = {"x": state["x"] + acc.sum()}
+        return s, _out(), jnp.int64(NEVER)
+    rep = lint_scenario(_mk(step, inbox_src=True))
+    assert not [f for f in rep.findings if f.code == "TW701"]
+
+
+def test_sanitizer_warns_on_transcendentals():
+    def step(state, inbox, now, i, key):
+        lam = jnp.exp(now.astype(jnp.float32) / 1e6)
+        s = {"x": state["x"] + lam.astype(jnp.int32)}
+        return s, _out(), jnp.int64(NEVER)
+    rep = lint_scenario(_mk(step))
+    tw702 = [f for f in rep.warnings if f.code == "TW702"]
+    assert tw702 and "exp" in tw702[0].message
+
+
+def test_sanitizer_flags_host_callback_in_traced_code():
+    def driver(x):
+        jax.debug.callback(lambda v: None, x)
+        return x + 1
+    closed = jax.make_jaxpr(driver)(jnp.int32(0))
+    rep = scan_jaxpr_determinism(closed.jaxpr, "planted")
+    assert "TW704" in [f.code for f in rep.errors]
+    # the step-level scan leaves host escapes to TW101
+    rep2 = scan_jaxpr_determinism(closed.jaxpr, "planted",
+                                  host_escapes=False)
+    assert "TW704" not in [f.code for f in rep2.findings]
+
+
+def test_sanitizer_flags_non_threefry_rng():
+    def driver(key):
+        return jax.random.bits(key, (4,))
+    key = jax.random.key(0, impl="rbg")
+    closed = jax.make_jaxpr(driver)(key)
+    rep = scan_jaxpr_determinism(closed.jaxpr, "planted")
+    assert "TW703" in [f.code for f in rep.errors]
+
+
+def test_lint_ignore_suppresses_tw7xx():
+    def step(state, inbox, now, i, key):
+        acc = jnp.zeros((4,), jnp.float32).at[inbox.src].add(1.5)
+        s = {"x": state["x"] + acc.sum().astype(jnp.int32)}
+        return s, _out(), jnp.int64(NEVER)
+    sc = _mk(step, inbox_src=True,
+             meta={"lint_ignore": ["TW701"]})
+    assert lint_scenario(sc).ok
+
+
+def test_engine_driver_scan_and_neutrality_proof():
+    from timewarp_tpu.cli import jaxpr_sweep
+    subjects, rep = jaxpr_sweep(["token-ring"], nodes=8)
+    assert rep.ok, rep.render()
+    # both engines swept (general + the static-topology edge variant),
+    # both neutrality proofs landed
+    proofs = [f for f in rep.infos if f.code == "TW705"]
+    assert {f.subject for f in proofs} == {"token-ring/general",
+                                           "token-ring/edge"}
+
+
+def test_neutrality_proof_catches_a_leaking_plane():
+    class FakeEngine:
+        def __init__(self, scale):
+            self.scale = scale
+
+        def init_state(self):
+            return jnp.zeros((2,), jnp.float32)
+
+        def _step_all(self, s, with_trace):
+            return s * self.scale
+
+    def build(telemetry="counters", **kw):
+        # telemetry='off' lowers a DIFFERENT jaxpr - the defect
+        return FakeEngine(3.0 if telemetry == "off" else 2.0)
+
+    rep = prove_mode_neutrality(build, "fake")
+    bad = [f for f in rep.errors if f.code == "TW705"]
+    assert len(bad) == 1 and "telemetry" in bad[0].message
+
+
+# ----------------------------------------------------------------------
+# the gates: sweep --lint, serve admission, CLI schema/exit codes
+# ----------------------------------------------------------------------
+
+DOOMED = {"scenario": "gossip", "params": {"nodes": 16},
+          "link": "uniform:1000:5000", "window": 900,
+          "faults": "degrade:all:all:1s:2s:0.1:0"}
+CLEAN = {"scenario": "gossip", "params": {"nodes": 16},
+         "link": "fixed:1000"}
+
+
+def test_sweep_service_refuses_doomed_pack_pre_build(tmp_path):
+    from timewarp_tpu.sweep.service import SweepService
+    from timewarp_tpu.sweep.spec import SweepPack
+    pack = SweepPack.from_json([DOOMED])
+    with pytest.raises(LintError) as ei:
+        SweepService(pack, str(tmp_path / "j"), lint="error")
+    assert "TW602" in str(ei.value)
+    # refused BEFORE any engine build or bucket journaling
+    assert not (tmp_path / "j").exists() \
+        or not any((tmp_path / "j").iterdir())
+    # warn admits the same pack (the findings go to the log)
+    svc = SweepService(pack, str(tmp_path / "j2"), lint="warn")
+    assert svc.lint == "warn"
+
+
+def test_serve_admission_refuses_with_finding_and_no_journal(tmp_path):
+    from timewarp_tpu.serve.frontend import ServeFrontend, ServeRejected
+    from timewarp_tpu.sweep.journal import SweepJournal
+    journal = SweepJournal(str(tmp_path), host="h0")
+    front = ServeFrontend(journal, "h0", ("127.0.0.1", 1),
+                          lint="error")
+    with pytest.raises(ServeRejected) as ei:
+        front.admit({**DOOMED, "id": "bad0"})
+    msg = str(ei.value)
+    assert "TW602" in msg and "pre-flight" in msg
+    # nothing journaled for the refused config: no admit, no bucket
+    recs = SweepJournal(str(tmp_path)).scan()
+    assert "bad0" not in recs.admits
+    assert not recs.serve_buckets
+    # a clean config still admits
+    rid, bid, slot = front.admit({**CLEAN, "id": "ok0"})
+    assert rid == "ok0"
+    assert "ok0" in SweepJournal(str(tmp_path)).scan().admits
+    journal.close()
+
+
+def test_serve_admission_lint_off_is_unchanged(tmp_path):
+    from timewarp_tpu.serve.frontend import ServeFrontend
+    from timewarp_tpu.sweep.journal import SweepJournal
+    journal = SweepJournal(str(tmp_path), host="h0")
+    front = ServeFrontend(journal, "h0", ("127.0.0.1", 1))
+    rid, _, _ = front.admit({**DOOMED, "id": "d0"})
+    assert rid == "d0"               # off = pre-gate behavior
+    journal.close()
+
+
+def test_lint_json_schema_and_exit_codes(capsys):
+    from timewarp_tpu.cli import lint_main
+    rc = lint_main(["ping-pong", "--json", "--no-probe"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    # the pinned schema: subjects + the LintReport.to_json keys
+    assert set(out) == {"subjects", "errors", "warnings", "infos",
+                        "findings"}
+    assert out["errors"] == 0
+    for f in out["findings"]:
+        assert {"code", "severity", "subject", "message"} <= set(f)
+
+
+def test_lint_pack_json_schema_and_exit_codes(tmp_path, capsys):
+    from timewarp_tpu.cli import lint_pack_main
+    clean = tmp_path / "clean.json"
+    clean.write_text(json.dumps([CLEAN]))
+    rc = lint_pack_main([str(clean), "--json"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert set(out) == {"configs", "errors", "warnings", "infos",
+                        "findings"}
+    assert out["configs"] == 1 and out["errors"] == 0
+
+    doomed = tmp_path / "doomed.json"
+    doomed.write_text(json.dumps([DOOMED]))
+    rc = lint_pack_main([str(doomed), "--json"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 1 and out["errors"] >= 1
+    assert "TW602" in [f["code"] for f in out["findings"]]
+
+
+def test_lint_jaxpr_exit_code(capsys):
+    from timewarp_tpu.cli import lint_main
+    rc = lint_main(["ping-pong", "--jaxpr", "--json"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 0 and out["errors"] == 0
+    assert any(f["code"] == "TW705" for f in out["findings"])
+
+
+def test_example_packs_lint_clean():
+    from timewarp_tpu.analysis import lint_pack_path
+    root = os.path.join(os.path.dirname(__file__), os.pardir,
+                        "examples", "packs")
+    packs = [p for p in sorted(os.listdir(root))
+             if p.endswith(".json") and "doomed" not in p]
+    assert packs, "no example packs shipped"
+    for p in packs:
+        n, rep = lint_pack_path(os.path.join(root, p))
+        assert rep.ok, f"{p}: {rep.render()}"
+
+
+def test_doomed_example_pack_is_refused():
+    from timewarp_tpu.analysis import lint_pack_path
+    p = os.path.join(os.path.dirname(__file__), os.pardir,
+                     "examples", "packs", "doomed.json")
+    n, rep = lint_pack_path(p)
+    codes = set(f.code for f in rep.errors)
+    # the three seeded dooms: controller x speculate, a degrade
+    # undercut, and a provable faulted overflow
+    assert {"TW600", "TW602", "TW205"} <= codes

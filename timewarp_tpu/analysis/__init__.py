@@ -13,6 +13,13 @@ checkable *before* any engine run:
   ``ThreadKilled`` (TW3xx).
 - :mod:`.probes` — seeded permutation probe for ``commutative_inbox``,
   the one flag dataflow cannot verify (TW4xx).
+- :mod:`.plan_lint` — fleet-scale pre-flight verification of sweep
+  packs / serve submissions: predicted bucket plans, engine-refusal
+  mirrors, pad-growth rebuild warnings (TW6xx).
+- :mod:`.determinism` — jaxpr-level bit-exactness threats (unordered
+  float reductions, platform-dependent transcendentals, non-threefry
+  randomness, host callbacks in traced engine code) and the generic
+  off-mode neutrality proof (TW7xx).
 
 Every engine runs :func:`check_scenario` at construction under its
 ``lint="error"|"warn"|"off"`` knob (default ``"warn"``); the CLI
@@ -26,9 +33,15 @@ from __future__ import annotations
 import logging
 
 from ..core.scenario import Scenario
-from .capacity import lint_capacity, worst_case_fan_in
+from .capacity import (lint_capacity, lint_capacity_faulted,
+                       max_delay_us, worst_case_fan_in)
+from .determinism import (lint_engine_jaxpr, lint_step_determinism,
+                          prove_mode_neutrality,
+                          scan_jaxpr_determinism)
 from .fault_lint import check_faults, lint_fault_schedule
 from .jaxpr_lint import HOST_ESCAPE_PRIMITIVES, lint_step_jaxpr
+from .plan_lint import (lint_pack, lint_pack_json, lint_pack_path,
+                        lint_run_config)
 from .probes import probe_commutative_inbox
 from .program_lint import (GENERATOR_COMBINATORS, lint_module_programs,
                            lint_program, lint_source)
@@ -41,6 +54,11 @@ __all__ = [
     "lint_scenario", "check_scenario", "LINT_MODES",
     "lint_fault_schedule", "check_faults",
     "lint_step_jaxpr", "lint_capacity", "worst_case_fan_in",
+    "lint_capacity_faulted", "max_delay_us",
+    "lint_pack", "lint_pack_json", "lint_pack_path",
+    "lint_run_config",
+    "lint_step_determinism", "lint_engine_jaxpr",
+    "prove_mode_neutrality", "scan_jaxpr_determinism",
     "probe_commutative_inbox",
     "lint_program", "lint_source", "lint_module_programs",
     "HOST_ESCAPE_PRIMITIVES", "GENERATOR_COMBINATORS",
@@ -63,6 +81,7 @@ def lint_scenario(scenario: Scenario, *, probe: bool = False,
     are suppressed (the documented opt-out, docs/authoring.md)."""
     rep = LintReport()
     rep.extend(lint_step_jaxpr(scenario))
+    rep.extend(lint_step_determinism(scenario))
     rep.extend(lint_capacity(scenario))
     if probe:
         rep.extend(probe_commutative_inbox(scenario, seed=seed))

@@ -1,0 +1,271 @@
+"""Determinism sanitizer (TW7xx): jaxpr-level bit-exactness threats.
+
+The whole framework rests on runs being bit-identical across engines,
+batching, checkpoints, and backends (core/scenario.py determinism
+contract; every law test compares sha256 digests). Four primitive
+families are known to break that silently — the run *works*, the
+digests just differ between platforms or executions:
+
+- **TW701** (error) — unordered float reductions: a float scatter-add
+  with duplicate indices (accumulation order is
+  implementation-defined) and float cross-device ``psum`` (reduction
+  tree order varies with topology). Integer scatter-adds are exact
+  and commutative — only floating accumulation is flagged.
+- **TW702** (warning) — platform-dependent transcendentals (exp, log,
+  tanh, erf, pow, ...): each backend's libm differs in the last ulp,
+  so float transcendentals are deterministic per-platform but not
+  bit-stable ACROSS platforms. Warning, not error: the shipped
+  heavy-tail link samplers (lognormal/pareto, net/delays.py) use them
+  deliberately and re-quantize to int64 µs — the documented way to
+  keep digests exact is exactly that, quantize before the result
+  re-enters int64 time.
+- **TW703** (error) — non-threefry randomness: ``rng_bit_generator``
+  (the XLA-native generator, backend-dependent streams), the legacy
+  ``rng_uniform``, and any typed-key ``random_*`` primitive consuming
+  a non-``fry`` key (``key<rbg>``/``key<urbg>`` — the impl rides the
+  key dtype). The framework's entropy is counter-based
+  threefry2x32 (core/rng.py) precisely so streams are
+  backend-invariant; any other generator silently forks the contract.
+- **TW704** (error) — host callbacks reachable from *traced engine
+  code* (same primitive set as the step-level TW101, jaxpr_lint.py):
+  a callback inside the lowered driver escapes virtual time entirely.
+
+Two scan surfaces share the checks: :func:`lint_step_determinism`
+scans a scenario's step jaxpr (runs inside ``lint_scenario``, so
+every engine construction and ``timewarp-tpu lint`` get it; TW101
+already covers host escapes there), and :func:`lint_engine_jaxpr`
+scans a built engine's lowered ``_step_all`` driver — everything the
+engine adds around the step: routing sorts, mailbox scatters, fault
+masks, telemetry/record/verify/speculation planes.
+
+:func:`prove_mode_neutrality` (TW705) generically re-proves the
+off-mode jaxpr-neutrality pins: for every observability/execution
+knob (telemetry, record, verify, speculate), an engine built with the
+knob explicitly ``"off"`` must lower to the byte-identical driver
+jaxpr of the baseline engine — the zero-overhead-off contract that
+was previously one hand-written pin per knob
+(tests/test_zztelemetry.py and siblings keep the named instances;
+this proves the family). ``timewarp-tpu lint --jaxpr`` runs both
+scans over every shipped engine x mode (cli.py ``jaxpr_sweep``).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+from ..utils import jaxconfig  # noqa: F401  (must precede jax use)
+
+import jax
+import jax.numpy as jnp
+
+from ..core.scenario import Scenario
+from .jaxpr_lint import (HOST_ESCAPE_PRIMITIVES, _all_jaxprs,
+                         _lint_avals)
+from .report import ERROR, INFO, WARNING, Finding, LintReport
+
+__all__ = ["lint_step_determinism", "lint_engine_jaxpr",
+           "prove_mode_neutrality", "scan_jaxpr_determinism",
+           "UNORDERED_FLOAT_REDUCTIONS", "TRANSCENDENTALS",
+           "NON_THREEFRY_RNG"]
+
+#: primitives whose float accumulation order is implementation-defined
+UNORDERED_FLOAT_REDUCTIONS = frozenset({
+    "scatter-add", "scatter-mul", "psum"})
+
+#: libm-backed primitives whose last-ulp behavior differs per backend
+TRANSCENDENTALS = frozenset({
+    "exp", "exp2", "expm1", "log", "log2", "log1p", "logistic",
+    "sin", "cos", "tan", "asin", "acos", "atan", "atan2",
+    "sinh", "cosh", "tanh", "asinh", "acosh", "atanh",
+    "erf", "erfc", "erf_inv", "lgamma", "digamma", "pow", "cbrt",
+})
+
+#: random primitives that are NOT counter-based threefry
+NON_THREEFRY_RNG = frozenset({"rng_bit_generator", "rng_uniform"})
+
+
+def _is_float(v) -> bool:
+    dt = getattr(getattr(v, "aval", None), "dtype", None)
+    return dt is not None and jnp.issubdtype(jnp.dtype(dt),
+                                             jnp.floating)
+
+
+def _key_impl(v) -> Optional[str]:
+    """The PRNG impl of a typed-key operand (``key<fry>`` /
+    ``key<rbg>`` / ...), or None for non-key avals. The typed-key
+    ``random_*`` primitives carry their generator in the key DTYPE,
+    not the primitive name."""
+    dt = getattr(getattr(v, "aval", None), "dtype", None)
+    s = str(dt) if dt is not None else ""
+    if s.startswith("key<") and s.endswith(">"):
+        return s[4:-1]
+    return None
+
+
+def scan_jaxpr_determinism(jaxpr, subject: str, *,
+                           host_escapes: bool = True) -> LintReport:
+    """Scan one (open) jaxpr — sub-jaxprs included — for the TW7xx
+    primitive families. ``host_escapes=False`` skips TW704 (the
+    step-level caller already reports TW101 for the same eqns)."""
+    rep = LintReport()
+    unordered, transcend, rng, escapes = {}, {}, {}, {}
+    for jx in _all_jaxprs(jaxpr):
+        for eqn in jx.eqns:
+            name = eqn.primitive.name
+            if name in UNORDERED_FLOAT_REDUCTIONS and (
+                    any(_is_float(v) for v in eqn.outvars)
+                    or any(_is_float(v) for v in eqn.invars)):
+                unordered[name] = unordered.get(name, 0) + 1
+            elif name in TRANSCENDENTALS and (
+                    any(_is_float(v) for v in eqn.outvars)):
+                transcend[name] = transcend.get(name, 0) + 1
+            elif name in NON_THREEFRY_RNG:
+                rng[name] = rng.get(name, 0) + 1
+            elif name.startswith("random_"):
+                # typed-key primitives: the generator is the key's
+                # DTYPE (key<fry> = threefry, key<rbg>/key<urbg> =
+                # the XLA-native backend-dependent generator)
+                impl = next((im for im in map(_key_impl, eqn.invars)
+                             if im is not None and im != "fry"), None)
+                if impl is not None:
+                    k = f"{name}[{impl}]"
+                    rng[k] = rng.get(k, 0) + 1
+            elif host_escapes and name in HOST_ESCAPE_PRIMITIVES:
+                escapes[name] = escapes.get(name, 0) + 1
+    for name, n in sorted(unordered.items()):
+        rep.add(Finding(
+            "TW701", ERROR, subject,
+            f"unordered float reduction {name!r} (x{n}): float "
+            "accumulation order is implementation-defined, so "
+            "duplicate-index scatters / cross-device sums produce "
+            "different bits per backend and break every digest law. "
+            "Accumulate in integers (fixed-point) or pre-sort a "
+            "unique-index scatter"))
+    for name, n in sorted(transcend.items()):
+        rep.add(Finding(
+            "TW702", WARNING, subject,
+            f"platform-dependent transcendental {name!r} (x{n}): "
+            "libm results differ in the last ulp across backends — "
+            "deterministic per platform, not bit-stable across them. "
+            "Quantize the result to integer µs before it re-enters "
+            "virtual time (the shipped heavy-tail samplers' "
+            "discipline, net/delays.py)"))
+    for name, n in sorted(rng.items()):
+        rep.add(Finding(
+            "TW703", ERROR, subject,
+            f"non-threefry randomness {name!r} (x{n}): its stream is "
+            "backend-dependent; the framework's entropy is "
+            "counter-based threefry2x32 (core/rng.py) so every "
+            "backend draws identical words — use jax.random with the "
+            "engine-provided key"))
+    for name, n in sorted(escapes.items()):
+        rep.add(Finding(
+            "TW704", ERROR, subject,
+            f"host callback {name!r} (x{n}) reachable from traced "
+            "engine code: a callback has no deterministic "
+            "virtual-time meaning and escapes the replay/digest "
+            "contract entirely"))
+    return rep
+
+
+def lint_step_determinism(sc: Scenario) -> LintReport:
+    """TW701-703 over a scenario's step jaxpr (TW101 owns host
+    escapes at this level). Traces under the engines' aval
+    conventions; untraceable steps are skipped silently — TW100
+    (jaxpr_lint.py) already reports the trace failure."""
+    try:
+        state0, inbox, now, nid, key = _lint_avals(sc)
+        closed = jax.make_jaxpr(sc.step)(state0, inbox, now, nid, key)
+    except Exception:  # noqa: BLE001 — TW100 reported it
+        if not sc.needs_key:
+            try:
+                state0, inbox, now, nid, _ = _lint_avals(sc)
+                closed = jax.make_jaxpr(sc.step)(
+                    state0, inbox, now, nid, None)
+            except Exception:  # noqa: BLE001
+                return LintReport()
+        else:
+            return LintReport()
+    return scan_jaxpr_determinism(closed.jaxpr, sc.name,
+                                  host_escapes=False)
+
+
+def _driver_jaxpr(engine):
+    """The lowered driver: the exact entry every chunked run scans
+    through (``_step_all`` — solo superstep or vmapped fleet step),
+    traced with the trace plane on, same as the hand-written
+    neutrality pins (tests/test_zztelemetry.py)."""
+    return jax.make_jaxpr(lambda s: engine._step_all(s, True))(
+        engine.init_state())
+
+
+def lint_engine_jaxpr(engine, subject: Optional[str] = None
+                      ) -> LintReport:
+    """TW701-704 over a built engine's lowered ``_step_all`` driver —
+    the step function PLUS everything the engine wraps around it
+    (routing, scatters, fault masks, observability planes)."""
+    name = subject or type(engine).__name__
+    try:
+        closed = _driver_jaxpr(engine)
+    except Exception as e:  # noqa: BLE001 — report, never crash
+        rep = LintReport()
+        rep.add(Finding(
+            "TW700", WARNING, name,
+            f"engine driver is not traceable under the sanitizer "
+            f"({e!r}); jaxpr determinism scan skipped"))
+        return rep
+    return scan_jaxpr_determinism(closed.jaxpr, name)
+
+
+#: the engine knobs whose "off" must lower to the baseline jaxpr
+NEUTRAL_KNOBS = ("telemetry", "record", "verify", "speculate")
+
+
+def prove_mode_neutrality(build_engine, subject: str,
+                          knobs: Tuple[str, ...] = NEUTRAL_KNOBS
+                          ) -> LintReport:
+    """TW705: generically re-prove the off-mode jaxpr-neutrality pins.
+    ``build_engine(**kw)`` constructs one engine; for every knob, the
+    engine built with the knob explicitly ``"off"`` must lower its
+    driver to the byte-identical jaxpr of the baseline (no-argument)
+    build — the zero-overhead-off contract. One INFO proof on
+    success; an ERROR naming the knob on any divergence."""
+    rep = LintReport()
+    try:
+        base = str(_driver_jaxpr(build_engine()))
+    except Exception as e:  # noqa: BLE001
+        rep.add(Finding(
+            "TW700", WARNING, subject,
+            f"baseline engine failed to build/trace under the "
+            f"neutrality proof ({e!r}); TW705 skipped"))
+        return rep
+    bad = []
+    for knob in knobs:
+        try:
+            off = str(_driver_jaxpr(build_engine(**{knob: "off"})))
+        except TypeError:
+            continue        # engine family without this knob
+        except Exception as e:  # noqa: BLE001
+            rep.add(Finding(
+                "TW705", ERROR, subject,
+                f"{knob}='off' engine failed to build/trace ({e!r}) "
+                "— explicit off must be indistinguishable from the "
+                "default"))
+            bad.append(knob)
+            continue
+        if off != base:
+            rep.add(Finding(
+                "TW705", ERROR, subject,
+                f"{knob}='off' lowers a DIFFERENT driver jaxpr than "
+                "the baseline engine: the zero-overhead-off contract "
+                "(docs/observability.md) requires the off mode to be "
+                "jaxpr-neutral — the plane is leaking into the "
+                "traced scan"))
+            bad.append(knob)
+    if not bad:
+        rep.add(Finding(
+            "TW705", INFO, subject,
+            f"off-mode neutrality proof: {'/'.join(knobs)} off all "
+            "lower byte-identical driver jaxprs to the baseline "
+            "(zero overhead off, generically re-proven)"))
+    return rep

@@ -1,9 +1,9 @@
 """Lint findings and the severity-ranked report.
 
 The scenario sanitizer's output surface: every checker in this package
-(`jaxpr_lint`, `capacity`, `program_lint`, `probes`) returns
-:class:`Finding`\\ s collected into one :class:`LintReport`. Severity is
-three-valued:
+(`jaxpr_lint`, `capacity`, `program_lint`, `probes`, `plan_lint`,
+`determinism`) returns :class:`Finding`\\ s collected into one
+:class:`LintReport`. Severity is three-valued:
 
 - ``error``   — a determinism-contract violation the engines would only
   surface dynamically (digest mismatch, silent mailbox drop, trace-time
@@ -39,7 +39,9 @@ class Finding:
 
     ``code`` is stable (``TW1xx`` jaxpr contract lints, ``TW2xx``
     capacity proofs, ``TW3xx`` effect-program AST lints, ``TW4xx``
-    probes); messages may be reworded freely.
+    probes, ``TW5xx`` fault-schedule lints, ``TW6xx`` sweep-pack plan
+    lints, ``TW7xx`` jaxpr determinism sanitizer); messages may be
+    reworded freely.
     """
     code: str
     severity: str
@@ -136,4 +138,4 @@ class LintError(TimeWarpError):
 
     def __init__(self, report: LintReport, who: str = "lint") -> None:
         self.report = report
-        super().__init__(f"{who}: scenario failed lint\n{report.render()}")
+        super().__init__(f"{who}: failed lint\n{report.render()}")

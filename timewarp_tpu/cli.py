@@ -444,6 +444,92 @@ def lint_sweep(families=None, *, nodes: int = 64, probe: bool = True,
     return subjects, report
 
 
+def jaxpr_sweep(families=None, *, nodes: int = 8):
+    """The ``lint --jaxpr`` sweep (analysis/determinism.py): build
+    every shipped engine family x observability/execution mode with an
+    integer-delay link, scan each lowered ``_step_all`` driver for
+    TW7xx bit-exactness threats, and generically re-prove the off-mode
+    jaxpr-neutrality pins (TW705) per family x engine. Returns
+    ``(subjects, LintReport)``; a mode that fails to build becomes a
+    TW000 error finding, never a crash. Small ``nodes`` by design —
+    the scan is abstract tracing, the primitive inventory of the
+    driver does not change with fleet width."""
+    from .analysis import (ERROR, Finding, LintReport,
+                           lint_engine_jaxpr, prove_mode_neutrality)
+    from .net.delays import FixedDelay
+
+    # integer µs delays: the heavy-tail samplers' float
+    # transcendentals (TW702, deliberate + quantized) would otherwise
+    # drown the sweep in known warnings
+    link = FixedDelay(1000)
+    modes = [
+        ("baseline", {}),
+        ("telemetry=counters", {"telemetry": "counters"}),
+        ("telemetry=full", {"telemetry": "full"}),
+        ("record=deliveries", {"record": "deliveries"}),
+        ("record=full", {"record": "full"}),
+        ("verify=guard", {"verify": "guard"}),
+        ("speculate=fixed:2000", {"speculate": "fixed:2000"}),
+    ]
+    scenarios, _ = lint_targets(families, nodes=nodes)
+    report = LintReport()
+    subjects = 0
+
+    def scan(subject, build):
+        nonlocal subjects
+        subjects += 1
+        try:
+            engine = build()
+        except Exception as e:  # noqa: BLE001 — sweep must finish
+            report.add(Finding(
+                "TW000", ERROR, subject,
+                f"engine failed to build under the jaxpr sweep: "
+                f"{e!r}"))
+            return
+        report.extend(lint_engine_jaxpr(engine, subject))
+
+    for fam, builders in scenarios.items():
+        built = []
+        for build in builders:
+            try:
+                built.append(build())
+            except Exception as e:  # noqa: BLE001 — sweep must finish
+                report.add(Finding(
+                    "TW000", ERROR, fam,
+                    f"scenario failed to build under the jaxpr "
+                    f"sweep: {e!r}"))
+        if not built:
+            continue
+        sc = built[0]
+
+        def gen(**kw):
+            from .interp.jax_engine.engine import JaxEngine
+            return JaxEngine(sc, link, seed=0, lint="off", **kw)
+
+        for label, kw in modes:
+            scan(f"{fam}/general/{label}", lambda kw=kw: gen(**kw))
+        subjects += 1
+        report.extend(prove_mode_neutrality(gen, f"{fam}/general"))
+
+        # the edge engine demands a static topology — sweep the
+        # family's first static variant, if it ships one
+        sc_e = next((s for s in built if s.static_dst is not None),
+                    None)
+        if sc_e is not None:
+            def edge(**kw):
+                from .interp.jax_engine.edge_engine import EdgeEngine
+                return EdgeEngine(sc_e, link, seed=0, lint="off",
+                                  **kw)
+
+            for label, kw in modes:
+                if "speculate" in kw:
+                    continue    # edge engine has no speculation plane
+                scan(f"{fam}/edge/{label}", lambda kw=kw: edge(**kw))
+            subjects += 1
+            report.extend(prove_mode_neutrality(edge, f"{fam}/edge"))
+    return subjects, report
+
+
 def lint_main(argv) -> int:
     """``timewarp-tpu lint``: run the scenario sanitizer (jaxpr
     contract lints + static capacity proofs + commutative-inbox
@@ -470,16 +556,29 @@ def lint_main(argv) -> int:
                    help="also lint this fault schedule (the --faults "
                         "run grammar) against every swept scenario — "
                         "the TW5xx rules (docs/faults.md)")
+    p.add_argument("--jaxpr", action="store_true",
+                   help="run the engine-level determinism sanitizer "
+                        "instead: scan every shipped engine x mode's "
+                        "lowered driver jaxpr for bit-exactness "
+                        "threats and re-prove the off-mode "
+                        "neutrality pins (TW7xx, docs/authoring.md)")
     args = p.parse_args(argv)
 
-    faults = None
-    if args.faults:
-        from .faults.schedule import parse_faults
-        faults = parse_faults(args.faults)
-    subjects, report = lint_sweep(args.families or None,
-                                  nodes=args.nodes,
-                                  probe=not args.no_probe,
-                                  seed=args.seed, faults=faults)
+    if args.jaxpr:
+        # default shrinks to 8: the driver's primitive inventory does
+        # not change with fleet width, only trace time does
+        nodes = 8 if args.nodes == 64 else args.nodes
+        subjects, report = jaxpr_sweep(args.families or None,
+                                       nodes=nodes)
+    else:
+        faults = None
+        if args.faults:
+            from .faults.schedule import parse_faults
+            faults = parse_faults(args.faults)
+        subjects, report = lint_sweep(args.families or None,
+                                      nodes=args.nodes,
+                                      probe=not args.no_probe,
+                                      seed=args.seed, faults=faults)
 
     if args.json:
         print(json.dumps({"subjects": subjects, **report.to_json()}))
@@ -489,10 +588,52 @@ def lint_main(argv) -> int:
     return 0 if report.ok else 1
 
 
+def lint_pack_main(argv) -> int:
+    """``timewarp-tpu lint-pack PACK``: the fleet-scale pre-flight
+    verifier (analysis/plan_lint.py). Statically predicts the pack's
+    bucket plan (engine builds, fleet widths, resolved windows, fault
+    pads), mirrors every construction-time refusal the runtime would
+    raise mid-bucket, and runs the full per-scenario sanitizer plus
+    the fault-aware capacity proof over every world — all before any
+    engine is built. Exits 1 on any error-severity finding (the same
+    contract as ``lint``); ``sweep run --lint error`` applies the
+    identical gate in-process."""
+    p = argparse.ArgumentParser(
+        prog="timewarp-tpu lint-pack",
+        description="Static pre-flight verification of a sweep pack "
+                    "(TW6xx + the per-world TW1xx-TW2xx/TW7xx rules; "
+                    "docs/sweeps.md 'Pre-flight verification').")
+    p.add_argument("pack",
+                   help="pack path: a JSON file ({\"worlds\": [...]} "
+                        "or a bare config list) or JSONL, the same "
+                        "grammar `sweep run` takes")
+    p.add_argument("--json", action="store_true",
+                   help="one JSON report line instead of findings text")
+    p.add_argument("--max-bucket", type=int, default=64,
+                   help="bucket width the plan is predicted at (must "
+                        "match the sweep run's --max-bucket to "
+                        "predict the same builds)")
+    args = p.parse_args(argv)
+
+    from .analysis import lint_pack_path
+    configs, report = lint_pack_path(args.pack,
+                                     max_bucket=args.max_bucket)
+    if args.json:
+        print(json.dumps({"configs": configs, **report.to_json()}))
+    else:
+        print(report.render())
+        print(f"({configs} config(s) linted)")
+    return 0 if report.ok else 1
+
+
 def main(argv=None) -> int:
     if argv is None:
         argv = sys.argv[1:]
     argv = list(argv)
+    if argv and argv[0] == "lint-pack":
+        # fleet-scale pre-flight verification of a sweep pack
+        # (analysis/plan_lint.py, TW6xx — docs/sweeps.md)
+        return lint_pack_main(argv[1:])
     if argv and argv[0] == "lint":
         return lint_main(argv[1:])
     if argv and argv[0] == "sweep":

@@ -66,7 +66,14 @@ def _serve(argv) -> int:
                    help="supersteps per chunk between checkpoints / "
                         "admission points")
     p.add_argument("--lint", default="off",
-                   choices=["error", "warn", "off"])
+                   choices=["error", "warn", "off"],
+                   help="pre-flight verification: 'error' refuses a "
+                        "ServeSubmit whose config fails the plan "
+                        "lint / scenario sanitizer / fault-aware "
+                        "capacity proof — findings in the reply, "
+                        "nothing journaled; also the curators' "
+                        "engine-construction lint knob "
+                        "(docs/serving.md 'Pre-flight verification')")
     p.add_argument("--lease-ttl-s", type=float, default=10.0,
                    help="lease staleness TTL: a host silent this long "
                         "has its buckets stolen")
@@ -123,7 +130,8 @@ def _serve(argv) -> int:
     from ..net.rpc import Rpc
     from ..net.transfer import Transport
     from .frontend import ServeFrontend
-    front = ServeFrontend(journal, me.name, listen, slots=args.slots)
+    front = ServeFrontend(journal, me.name, listen, slots=args.slots,
+                          lint=args.lint)
     worker = None
     killed: List[BaseException] = []
     if cur is not None:

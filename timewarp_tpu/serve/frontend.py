@@ -135,14 +135,24 @@ class ServeFrontend:
 
     def __init__(self, journal: SweepJournal, host: str,
                  listen: Tuple[str, int], *, slots: int = 4,
-                 poll_us: int = 100_000) -> None:
+                 poll_us: int = 100_000, lint: str = "off") -> None:
         if slots < 1:
             raise ValueError(f"--slots must be >= 1, got {slots}")
+        from ..analysis import LINT_MODES
+        if lint not in LINT_MODES:
+            raise ValueError(
+                f"lint must be one of {LINT_MODES}, got {lint!r}")
         self.journal = journal
         self.host = host
         self.listen = listen
         self.slots = int(slots)
         self.poll_us = int(poll_us)
+        #: admission-time pre-flight verification (plan_lint.py,
+        #: docs/serving.md "Pre-flight verification"): "error"
+        #: refuses a submission with the findings in the ServeRejected
+        #: reply — BEFORE any journal record (no bucket_open, no
+        #: admit), so a refused config leaves no admission trace
+        self.lint = lint
         #: key sha -> [bucket_id, ...] (newest last) — open buckets
         self._by_key: Dict[str, List[str]] = {}
         #: bucket_id -> {"capacity", "used": set(slot), "key"}
@@ -223,6 +233,23 @@ class ServeFrontend:
             raise ServeRejected(
                 f"run_id {cfg.run_id!r} is already admitted with a "
                 "different config — run_ids are unique per service")
+        if self.lint != "off":
+            # pre-flight verification at admission (plan_lint.py):
+            # every refusal the curator would hit mid-bucket — window
+            # undercuts, doomed speculation, the scenario sanitizer,
+            # fault-aware capacity proofs — refused HERE, with the
+            # pinned findings in the reply and nothing journaled
+            from ..analysis import lint_run_config
+            rep = lint_run_config(cfg)
+            if self.lint == "error" and not rep.ok:
+                raise ServeRejected(
+                    f"config {cfg.run_id!r} failed pre-flight lint "
+                    "(docs/serving.md 'Pre-flight verification'):\n"
+                    + "\n".join(f.render() for f in rep.errors))
+            for f in rep.errors:
+                _log.warning("admission lint: %s", f.render())
+            for f in rep.warnings:
+                _log.info("admission lint: %s", f.render())
         try:
             key = bucket_key_sha(cfg)
         except SweepConfigError as e:

@@ -42,12 +42,19 @@ __all__ = ["sweep_main"]
 
 
 def _loud(fn):
-    """Library config errors (SweepConfigError, and the service's
+    """Library config errors (SweepConfigError, the service's
     construction-time ValueError guards — bad chunk/retries, an
-    unarmed flip injection) become clean CLI exits, keeping the
-    guard-named message without a traceback."""
+    unarmed flip injection — and a ``--lint error`` pack refusal)
+    become clean CLI exits, keeping the guard-named message without a
+    traceback."""
+    from ..analysis import LintError
     try:
         return fn()
+    except LintError as e:
+        # the pre-flight verifier refused the pack (plan_lint.py):
+        # exit with the pinned findings, one per line — no engine was
+        # built, nothing was journaled
+        raise SystemExit(str(e)) from None
     except (SweepConfigError, ValueError) as e:
         raise SystemExit(str(e)) from None
 
@@ -69,7 +76,14 @@ def _service_args(p: argparse.ArgumentParser) -> None:
     p.add_argument("--max-bucket", type=int, default=64,
                    help="max worlds per batched bucket")
     p.add_argument("--lint", default="warn",
-                   choices=["error", "warn", "off"])
+                   choices=["error", "warn", "off"],
+                   help="pre-flight verification of the whole pack "
+                        "before any bucket builds (plan lint + "
+                        "per-world sanitizer + fault-aware capacity "
+                        "proofs) AND per-engine construction lint: "
+                        "'error' refuses with the findings, 'warn' "
+                        "(default) logs them, 'off' skips "
+                        "(docs/sweeps.md 'Pre-flight verification')")
     p.add_argument("--inject", default=None,
                    help="deterministic failure injection: fail:K | "
                         "oom:K | die:K | hang:K:MS | "

@@ -285,6 +285,26 @@ class SweepService:
         self.grace_us = int(grace_us)
         self.max_bucket = max_bucket
         self.lint = lint
+        # fleet-scale pre-flight verification (analysis/plan_lint.py,
+        # docs/sweeps.md "Pre-flight verification"): the whole pack is
+        # linted BEFORE any bucket engine is built — every refusal the
+        # runtime would raise mid-bucket (TW6xx window/speculation
+        # mirrors), the per-world scenario sanitizer, and the
+        # fault-aware capacity proofs. "error" refuses the pack with
+        # the findings (LintError), "warn" logs them, "off" skips;
+        # per-engine construction lint below keeps the same knob.
+        if lint != "off":
+            from ..analysis import LINT_MODES, LintError, lint_pack
+            if lint not in LINT_MODES:
+                raise ValueError(
+                    f"lint must be one of {LINT_MODES}, got {lint!r}")
+            _rep = lint_pack(pack, max_bucket=max_bucket)
+            if lint == "error" and not _rep.ok:
+                raise LintError(_rep, who="sweep pack")
+            for _f in _rep.errors:
+                _log.warning("pack lint: %s", _f.render())
+            for _f in _rep.warnings:
+                _log.info("pack lint: %s", _f.render())
         self.inject = (InjectPlan(inject) if isinstance(inject, str)
                        else inject)
         if getattr(self.inject, "flip", None) \

@@ -64,6 +64,18 @@ class SweepConfigError(ValueError):
     """A pack config is malformed — raised naming the ``run_id``."""
 
 
+#: the pack-entry grammar, quoted by every malformed-field refusal —
+#: the LINK_GRAMMAR/FAULT_GRAMMAR discipline (net/links.py,
+#: faults/schedule.py): a typo dies naming the field, never a raw
+#: KeyError/TypeError from deeper in the machinery
+PACK_GRAMMAR = (
+    'a pack entry is {"scenario": FAMILY, "id": str?, '
+    '"params": {name: value}?, "link": LINK_SPEC?, "seed": int?, '
+    '"window": int_us|"auto"?, "budget": int?, "faults": FAULT_SPEC?, '
+    '"controller": "off"|"auto"?, '
+    '"speculate": "off"|"auto"|"fixed:W"?} (docs/sweeps.md)')
+
+
 @dataclass(frozen=True)
 class RunConfig:
     """One world of a sweep pack (module docstring). ``params`` is
@@ -113,7 +125,9 @@ class RunConfig:
                 f"config {self.run_id!r}: seed must be an int, "
                 f"got {self.seed!r}")
         if self.window != "auto" and (
-                not isinstance(self.window, int) or self.window < 1):
+                isinstance(self.window, bool)
+                or not isinstance(self.window, int)
+                or self.window < 1):
             raise SweepConfigError(
                 f"config {self.run_id!r}: window must be an int µs "
                 f">= 1 or 'auto', got {self.window!r}")
@@ -149,7 +163,11 @@ class RunConfig:
         if extra:
             raise SweepConfigError(
                 f"pack entry {index}: unknown keys {sorted(extra)}; "
-                f"allowed: {sorted(known)}")
+                f"allowed: {sorted(known)} — {PACK_GRAMMAR}")
+        if "scenario" not in d:
+            raise SweepConfigError(
+                f"pack entry {index}: missing \"scenario\" — every "
+                f"entry names its family; {PACK_GRAMMAR}")
 
         def intf(key, default):
             # validate, don't coerce: int("abc") would be a raw
@@ -159,19 +177,38 @@ class RunConfig:
             if isinstance(v, bool) or not isinstance(v, int):
                 raise SweepConfigError(
                     f"pack entry {index}: {key} must be an integer, "
-                    f"got {v!r}")
+                    f"got {v!r} — {PACK_GRAMMAR}")
             return v
+
+        def strf(key, default):
+            v = d.get(key, default)
+            if v is not default and not isinstance(v, str):
+                raise SweepConfigError(
+                    f"pack entry {index}: {key} must be a string "
+                    f"spec, got {v!r} — {PACK_GRAMMAR}")
+            return v
+        params = d.get("params") or {}
+        if not isinstance(params, dict):
+            raise SweepConfigError(
+                f"pack entry {index}: params must be a JSON object "
+                f"of builder params, got {params!r} — {PACK_GRAMMAR}")
+        window = d.get("window", 1)
+        if isinstance(window, bool):
+            # bool ⊂ int would silently read true as window=1 µs
+            raise SweepConfigError(
+                f"pack entry {index}: window must be an int µs or "
+                f"'auto', got {window!r} — {PACK_GRAMMAR}")
         return cls(
             run_id=str(d.get("id", f"w{index}")),
-            family=d.get("scenario", ""),
-            params=tuple(sorted((d.get("params") or {}).items())),
-            link=d.get("link", "uniform:1000:5000"),
+            family=strf("scenario", ""),
+            params=tuple(sorted(params.items())),
+            link=strf("link", "uniform:1000:5000"),
             seed=intf("seed", 0),
-            window=d.get("window", 1),
+            window=window,
             budget=intf("budget", 1000),
-            faults=d.get("faults"),
-            controller=d.get("controller", "off"),
-            speculate=d.get("speculate", "off"),
+            faults=strf("faults", None),
+            controller=strf("controller", "off"),
+            speculate=strf("speculate", "off"),
         )
 
     def to_json(self) -> Dict[str, Any]:
