@@ -726,10 +726,17 @@ def bench_sweep_hetero(n, steps):
     exercised every time, not just in tests). Gated by the sweep
     survival law before the number counts: every streamed per-world
     result record — chained trace digest + never-silent counters —
-    must be bit-identical to the solo run of that config. Reports
-    aggregate delivered-msg/s through the service (journal + atomic
-    checkpoints included — this is service throughput, not bare
-    engine throughput)."""
+    must be bit-identical to the solo run of that config. Runs the
+    SAME pack twice — ``--pack first-fit`` and ``--pack predicted``
+    (timewarp_tpu/pack/, docs/sweeps.md "Predictive packing") — and
+    gates the packed leg in-bench: strictly better
+    ``budget_efficiency``, no worse ``pad_waste_frac``, identical
+    engine-build count, survival law on both legs, and one journaled
+    ``pack_decision`` per bucket (first-fit journals none). Reports
+    the packed leg's aggregate delivered-msg/s through the service
+    (journal + atomic checkpoints included — service throughput, not
+    bare engine throughput) with both legs' packing rollups on the
+    line."""
     import shutil
     import tempfile
 
@@ -737,6 +744,11 @@ def bench_sweep_hetero(n, steps):
 
     n = n or 4096
     steps = steps or 2000
+    # the half-budget world's budget is the largest pow2 <= steps/2:
+    # a pow2 budget drains on exact scan rungs, so the packing gate
+    # below measures PACKING (which worlds share a bucket), not the
+    # pow2 rung residue of an arbitrary odd budget
+    half = max(8, 1 << (max(1, steps // 2).bit_length() - 1))
     ring = {"nodes": n, "n_tokens": max(4, n // 64), "think_us": 2000,
             "end_us": 1 << 40, "mailbox_cap": 8}
     gossip = {"nodes": n, "fanout": 4, "burst": True,
@@ -745,8 +757,7 @@ def bench_sweep_hetero(n, steps):
         {"id": "ring-s0", "scenario": "token-ring", "params": ring,
          "link": "uniform:1000:5000", "seed": 0, "budget": steps},
         {"id": "ring-s1", "scenario": "token-ring", "params": ring,
-         "link": "uniform:2000:7000", "seed": 1,
-         "budget": max(steps // 2, 8)},
+         "link": "uniform:2000:7000", "seed": 1, "budget": half},
         {"id": "ring-chaos", "scenario": "token-ring", "params": ring,
          "link": "uniform:1000:5000", "seed": 2, "budget": steps,
          "faults": "crash:3:5ms:40ms:reset; partition:0-1|2-3:10ms:30ms"},
@@ -757,31 +768,94 @@ def bench_sweep_hetero(n, steps):
          "link": "quantize:1000:uniform:4000:8000", "seed": 4,
          "window": "auto", "budget": steps},
     ])
-    d = tempfile.mkdtemp(prefix="tw_sweep_bench_")
-    try:
-        t0 = time.perf_counter()
-        svc = SweepService(pack, d, chunk=max(64, steps // 8),
-                           lint="off", inject="fail:2")
-        report = svc.run()
-        dt = time.perf_counter() - t0
-        assert report.ok, f"sweep failed: {report.to_json()}"
-        assert report.retries >= 1, \
-            "the injected transient failure never exercised the retry path"
-        # the survival law, world by world (solo re-runs — the gate
-        # deliberately costs a second pass)
-        for rid, res in report.done.items():
-            want = solo_result(pack.by_id(rid), lint="off")
-            assert want == res, (
-                f"sweep survival law violated for {rid}:\n"
-                f"  solo:     {want}\n  streamed: {res}")
-        delivered = sum(r["delivered"] for r in report.done.values())
-        extra = {"worlds": report.total, "buckets": report.buckets,
-                 "retries": report.retries, "splits": report.splits}
-    finally:
-        shutil.rmtree(d, ignore_errors=True)
+    from timewarp_tpu.sweep.journal import SweepJournal, util_rollup
+
+    def leg(pack_mode):
+        d = tempfile.mkdtemp(prefix="tw_sweep_bench_")
+        try:
+            t0 = time.perf_counter()
+            # max_bucket=2 makes the packing decision REAL at this
+            # pack's scale: the three token-ring worlds (budgets
+            # steps, steps/2, steps in pack order) cannot share one
+            # bucket, so first-fit pairs a half-budget world with a
+            # full-budget one while predicted re-sorts the group
+            # best-fit-decreasing and pairs like with like
+            # pow2 chunk for the same reason as the pow2 half budget
+            chunk = max(64, 1 << (max(1, steps // 8).bit_length() - 1))
+            svc = SweepService(pack, d, chunk=chunk,
+                               lint="off", inject="fail:2",
+                               max_bucket=2, pack_mode=pack_mode)
+            report = svc.run()
+            dt = time.perf_counter() - t0
+            assert report.ok, f"sweep failed: {report.to_json()}"
+            assert report.retries >= 1, \
+                "the injected transient failure never exercised " \
+                "the retry path"
+            # the survival law, world by world, on BOTH legs: packing
+            # is pure throughput — streamed results must be
+            # bit-identical to solo regardless of bucketing (the gate
+            # deliberately costs a second pass)
+            for rid, res in report.done.items():
+                want = solo_result(pack.by_id(rid), lint="off")
+                assert want == res, (
+                    f"sweep survival law violated for {rid} "
+                    f"({pack_mode}):\n"
+                    f"  solo:     {want}\n  streamed: {res}")
+            scan = SweepJournal(d).scan()
+            roll = util_rollup(scan.util)
+            builds = sum(int(u.get("engine_builds", 0))
+                         for u in scan.util.values())
+            return {"report": report, "dt": dt, "roll": roll,
+                    "builds": builds,
+                    "decisions": len(scan.pack_decisions),
+                    "delivered": sum(r["delivered"]
+                                     for r in report.done.values())}
+        finally:
+            shutil.rmtree(d, ignore_errors=True)
+
+    ff = leg("first-fit")
+    pr = leg("predicted")
+    # the in-bench packing gate (docs/sweeps.md "Predictive
+    # packing"): on the same pack, the packed leg must strictly win
+    # budget efficiency, never lose pad waste, and build exactly as
+    # many engines — packing changes WHERE worlds run, never what
+    # they compute or how often anything compiles
+    assert pr["roll"]["budget_efficiency"] \
+            > ff["roll"]["budget_efficiency"], (
+        f"predicted packing did not beat first-fit: "
+        f"budget_efficiency {pr['roll']} vs {ff['roll']}")
+    assert pr["roll"]["pad_waste_frac"] \
+            <= ff["roll"]["pad_waste_frac"] + 1e-9, (
+        f"predicted packing grew pad waste: {pr['roll']} "
+        f"vs {ff['roll']}")
+    assert pr["builds"] == ff["builds"], (
+        f"packing changed engine build count: {pr['builds']} "
+        f"predicted vs {ff['builds']} first-fit")
+    assert ff["decisions"] == 0, \
+        "first-fit journaled pack_decision records (the first-fit " \
+        "plan is a pure function of the pack — nothing to journal)"
+    assert pr["decisions"] == pr["report"].buckets, (
+        f"predicted leg journaled {pr['decisions']} pack_decision "
+        f"records for {pr['report'].buckets} buckets — the plan "
+        "must be journaled one record per bucket before any starts")
+    extra = {"worlds": pr["report"].total,
+             "buckets": pr["report"].buckets,
+             "retries": pr["report"].retries,
+             "splits": pr["report"].splits,
+             # the packing rollups (sweep/journal.py util_rollup) —
+             # promoted to the ledger index so `ledger compare`
+             # rate-gates packing regressions across rounds
+             "budget_efficiency": pr["roll"]["budget_efficiency"],
+             "pad_waste_frac": pr["roll"]["pad_waste_frac"],
+             "first_fit_budget_efficiency":
+                 ff["roll"]["budget_efficiency"],
+             "first_fit_pad_waste_frac":
+                 ff["roll"]["pad_waste_frac"],
+             "pack_decisions": pr["decisions"]}
     return (f"heterogeneous sweep service (retry + stream + survival "
-            f"law) aggregate delivered-messages/sec @{n} nodes",
-            delivered / dt, extra)
+            f"law + predictive packing gate) aggregate "
+            f"delivered-messages/sec @{n} nodes",
+            pr["delivered"] / pr["dt"], extra)
 
 
 def _bursty_gossip(n):
@@ -1424,7 +1498,16 @@ def bench_serve_gossip(n, steps):
     submit->world_done latency on the BENCH_SCHEMA=2 line. Gated by
     the extended survival law before the number counts: every
     streamed record's result must be bit-identical to the solo run
-    of its config."""
+    of its config. Runs TWO legs — ``--pack first-fit`` then ``--pack
+    predicted`` with a forecaster fitted in-bench from the first
+    leg's own results (training_rows -> fit_rows, pack/predict.py) —
+    and gates the predicted leg: one journaled ``pack_decision`` per
+    admission BEFORE its admit record naming the bucket the admit
+    landed in, engine builds unchanged, survival law on both legs.
+    Both legs' ``budget_efficiency``/``pad_waste_frac`` rollups ride
+    the line for `ledger compare` (the strict packed-vs-first-fit
+    win is gated where the plan is deterministic —
+    ``bench_sweep_hetero``)."""
     import shutil
     import tempfile
     import threading
@@ -1446,83 +1529,154 @@ def bench_serve_gossip(n, steps):
         if i == 3:
             d["faults"] = "crash:1:5ms:40ms:reset"
         cfgs.append(d)
-    root = tempfile.mkdtemp(prefix="tw_serve_bench_")
-    try:
-        journal = SweepJournal(root, host="bench")
-        front = ServeFrontend(journal, "bench", ("127.0.0.1", 0),
-                              slots=8)
-        cur = ServeCurator(root, "bench", chunk=max(32, steps // 8),
-                           lint="off", lease_ttl_s=60.0,
-                           poll_s=0.02, journal=journal)
-        t0 = time.perf_counter()
-        for d in cfgs[:4]:
-            front.admit(d)
-        admit_half = time.perf_counter()
-        worker = threading.Thread(target=cur.run, daemon=True)
-        worker.start()
-        # mid-bucket admission: the curator is already running the
-        # first chunks when these land in the reserved slots
-        for d in cfgs[4:]:
-            front.admit(d)
-        admit_done = time.perf_counter()
-        journal.append({"ev": "serve_drain", "host": "bench"})
-        worker.join(timeout=600)
-        assert not worker.is_alive(), "serve curator never drained"
-        dt = time.perf_counter() - t0
-        scan = SweepJournal(root).scan()
-        assert sorted(scan.done) == sorted(d["id"] for d in cfgs), \
-            f"unserved worlds: {sorted(scan.done)}"
-        # the extended survival law, world by world (the gate
-        # deliberately costs a second pass — docs/serving.md)
-        for d in cfgs:
-            cfg = RunConfig.from_json(d, 0)
-            want = solo_result(cfg, lint="off")
-            got = scan.done[d["id"]]
-            assert want == got, (
-                f"serve survival law violated for {d['id']}:\n"
-                f"  solo:     {want}\n  streamed: {got}")
-        # submit->world_done latency per world from the journal's own
-        # ts stamps (admit append -> world_done append, one clock)
-        t_admit, t_done = {}, {}
-        for e in scan.events:
-            if e.get("ev") == "admit" \
-                    and e["run_id"] not in t_admit:
-                t_admit[e["run_id"]] = float(e["ts"])
-            elif e.get("ev") == "world_done":
-                t_done[e["result"]["run_id"]] = float(e["ts"])
-        lats = sorted(t_done[r] - t_admit[r] for r in t_done)
-        p50 = lats[len(lats) // 2]
-        p95 = lats[min(len(lats) - 1, int(len(lats) * 0.95))]
-        delivered = sum(r["delivered"] for r in scan.done.values())
-        # the zero-recompile serving gate: 4 of the 8 configs landed
-        # mid-bucket (one faulted, fault-pad-compatible with the
-        # warmup build), yet the bucket's executable compiled ONCE —
-        # admission is an operand write, never a rebuild
-        builds = {b: u.get("engine_builds")
-                  for b, u in scan.util.items()}
-        assert builds and all(v == 1 for v in builds.values()), (
-            f"mid-bucket admission rebuilt an engine: {builds} — "
-            "the zero-recompile serving law "
-            "(serve/worker.py rebind_identity)")
-        compiles = sum(int(u.get("compiles", 0))
-                       for u in scan.util.values())
-        extra = {
-            "worlds": len(cfgs),
-            "admit_per_s": round(
-                len(cfgs) / max(1e-9, (admit_half - t0)
-                                + (admit_done - admit_half)), 2),
-            "submit_p50_s": round(p50, 4),
-            "submit_p95_s": round(p95, 4),
-            "buckets": len(scan.serve_buckets),
-            "engine_builds": sum(builds.values()),
-            "compiles": compiles,
-            "delivered_per_s": round(delivered / dt, 2),
-        }
-    finally:
-        shutil.rmtree(root, ignore_errors=True)
+    from timewarp_tpu.sweep.journal import util_rollup
+
+    def leg(pack_mode, artifact=None):
+        root = tempfile.mkdtemp(prefix="tw_serve_bench_")
+        try:
+            journal = SweepJournal(root, host="bench")
+            front = ServeFrontend(journal, "bench", ("127.0.0.1", 0),
+                                  slots=8, pack_mode=pack_mode,
+                                  pack_artifact=artifact)
+            cur = ServeCurator(root, "bench",
+                               chunk=max(32, steps // 8),
+                               lint="off", lease_ttl_s=60.0,
+                               poll_s=0.02, journal=journal,
+                               pack_mode=pack_mode,
+                               pack_artifact=artifact)
+            t0 = time.perf_counter()
+            for d in cfgs[:4]:
+                front.admit(d)
+            admit_half = time.perf_counter()
+            worker = threading.Thread(target=cur.run, daemon=True)
+            worker.start()
+            # mid-bucket admission: the curator is already running
+            # the first chunks when these land in the reserved slots
+            for d in cfgs[4:]:
+                front.admit(d)
+            admit_done = time.perf_counter()
+            journal.append({"ev": "serve_drain", "host": "bench"})
+            worker.join(timeout=600)
+            assert not worker.is_alive(), "serve curator never drained"
+            dt = time.perf_counter() - t0
+            scan = SweepJournal(root).scan()
+            assert sorted(scan.done) == sorted(d["id"] for d in cfgs), \
+                f"unserved worlds: {sorted(scan.done)}"
+            # the extended survival law, world by world, on BOTH legs
+            # (the gate deliberately costs a second pass —
+            # docs/serving.md): placement policy changes WHERE a world
+            # runs, never what it streams
+            for d in cfgs:
+                cfg = RunConfig.from_json(d, 0)
+                want = solo_result(cfg, lint="off")
+                got = scan.done[d["id"]]
+                assert want == got, (
+                    f"serve survival law violated for {d['id']} "
+                    f"({pack_mode}):\n"
+                    f"  solo:     {want}\n  streamed: {got}")
+            # submit->world_done latency per world from the journal's
+            # own ts stamps (admit append -> world_done append, one
+            # clock)
+            t_admit, t_done = {}, {}
+            for e in scan.events:
+                if e.get("ev") == "admit" \
+                        and e["run_id"] not in t_admit:
+                    t_admit[e["run_id"]] = float(e["ts"])
+                elif e.get("ev") == "world_done":
+                    t_done[e["result"]["run_id"]] = float(e["ts"])
+            lats = sorted(t_done[r] - t_admit[r] for r in t_done)
+            p50 = lats[len(lats) // 2]
+            p95 = lats[min(len(lats) - 1, int(len(lats) * 0.95))]
+            delivered = sum(r["delivered"]
+                            for r in scan.done.values())
+            # the zero-recompile serving gate, pinned on BOTH legs: 4
+            # of the 8 configs landed mid-bucket (one faulted,
+            # fault-pad-compatible with the warmup build), yet each
+            # bucket's executable compiled ONCE — admission is an
+            # operand write, never a rebuild, whichever bucket the
+            # placement policy picked
+            builds = {b: u.get("engine_builds")
+                      for b, u in scan.util.items()}
+            assert builds and all(v == 1 for v in builds.values()), (
+                f"mid-bucket admission rebuilt an engine ("
+                f"{pack_mode}): {builds} — the zero-recompile "
+                "serving law (serve/worker.py rebind_identity)")
+            compiles = sum(int(u.get("compiles", 0))
+                           for u in scan.util.values())
+            return {
+                "dt": dt, "scan": scan,
+                "roll": util_rollup(scan.util),
+                "admit_per_s": round(
+                    len(cfgs) / max(1e-9, (admit_half - t0)
+                                    + (admit_done - admit_half)), 2),
+                "p50": p50, "p95": p95,
+                "builds": sum(builds.values()),
+                "compiles": compiles, "delivered": delivered,
+            }
+        finally:
+            shutil.rmtree(root, ignore_errors=True)
+
+    ff = leg("first-fit")
+    # fit the superstep forecaster from the first leg's own journal —
+    # the full training loop (training_rows -> fit_rows) exercised
+    # in-bench, exactly what `ledger add` + `pack fit` assemble
+    from timewarp_tpu.pack import fit_rows, training_rows
+    rows = training_rows(
+        [RunConfig.from_json(d, 0) for d in cfgs], ff["scan"].done)
+    assert len(rows) == len(cfgs), \
+        f"training_rows dropped worlds: {len(rows)}/{len(cfgs)}"
+    art = fit_rows(rows)
+    pr = leg("predicted", artifact=art)
+    # the predictive-placement gate: every admission journaled ONE
+    # pack_decision BEFORE its admit record (decision-before-effect),
+    # naming the bucket the admit then landed in; first-fit journals
+    # nothing (its placement is a pure function of admission order)
+    assert not ff["scan"].pack_decisions, \
+        "first-fit leg journaled pack_decision records"
+    places = {d["run_id"]: d for d in pr["scan"].pack_decisions
+              if d.get("kind") == "place"}
+    assert sorted(places) == sorted(x["id"] for x in cfgs), (
+        f"predicted leg journaled placements for {sorted(places)}, "
+        f"admitted {sorted(x['id'] for x in cfgs)}")
+    for rid, a in pr["scan"].admits.items():
+        if "repacked_from" in a:
+            continue
+        assert places[rid]["bucket"] == a["bucket"], (
+            f"pack_decision for {rid} named bucket "
+            f"{places[rid]['bucket']} but the admit landed in "
+            f"{a['bucket']} — the journaled decision must BE the "
+            "placement")
+    # packing rollups on both legs: with one 8-slot bucket the two
+    # policies pack identically, so the packed leg must not LOSE
+    # anything — the strict packed-vs-first-fit win is gated where
+    # the plan is deterministic (bench_sweep_hetero); here the gate
+    # pins that predicted placement + its journaling perturb nothing
+    assert pr["builds"] == ff["builds"], (
+        f"placement policy changed engine build count: "
+        f"{pr['builds']} predicted vs {ff['builds']} first-fit")
+    extra = {
+        "worlds": len(cfgs),
+        "admit_per_s": pr["admit_per_s"],
+        "submit_p50_s": round(pr["p50"], 4),
+        "submit_p95_s": round(pr["p95"], 4),
+        "buckets": len(pr["scan"].serve_buckets),
+        "engine_builds": pr["builds"],
+        "compiles": pr["compiles"],
+        "delivered_per_s": round(pr["delivered"] / pr["dt"], 2),
+        # the packing rollups (sweep/journal.py util_rollup) —
+        # promoted to the ledger index so `ledger compare` rate-gates
+        # packing regressions across rounds
+        "budget_efficiency": pr["roll"]["budget_efficiency"],
+        "pad_waste_frac": pr["roll"]["pad_waste_frac"],
+        "first_fit_budget_efficiency":
+            ff["roll"]["budget_efficiency"],
+        "first_fit_pad_waste_frac": ff["roll"]["pad_waste_frac"],
+        "pack_decisions": len(pr["scan"].pack_decisions),
+        "predictor_sha": art["sha"][:12],
+    }
     return (f"emulation service (admission + open buckets + stream + "
-            f"survival law) served configs/sec @{n} nodes",
-            len(cfgs) / dt, extra)
+            f"survival law + predictive placement) served "
+            f"configs/sec @{n} nodes", len(cfgs) / pr["dt"], extra)
 
 
 def bench_lint_sweep(n, steps):

@@ -519,3 +519,77 @@ def test_good_pack_entries_round_trip():
     # every to_json survives its own from_json field-for-field
     for i, c in enumerate(pack.configs):
         assert RunConfig.from_json(c.to_json(), i) == c
+
+
+# ---------------------------------------------------------------------------
+# the --pack knob: grammar (pack/allocate.py, predictive packing)
+# ---------------------------------------------------------------------------
+
+BAD_PACK_MODES = [
+    "",                 # empty
+    "best-fit",         # the algorithm, not the knob value
+    "firstfit",         # missing dash
+    "first fit",        # space, not dash
+    "Predicted",        # case matters
+    "predicted ",       # trailing whitespace
+    "predict",          # truncated
+    "bfd",              # insider shorthand
+    "first-fit|predicted",  # the grammar string itself is not a value
+]
+
+
+@pytest.mark.parametrize("mode", BAD_PACK_MODES)
+def test_malformed_pack_modes_name_the_grammar(mode):
+    from timewarp_tpu.pack.allocate import (PACK_MODE_GRAMMAR,
+                                            validate_pack_mode)
+    from timewarp_tpu.sweep.spec import SweepConfigError
+    with pytest.raises(SweepConfigError) as ei:
+        validate_pack_mode(mode)
+    msg = str(ei.value)
+    assert "grammar" in msg and PACK_MODE_GRAMMAR in msg, \
+        f"{mode!r} died without naming PACK_MODE_GRAMMAR: {msg}"
+
+
+@pytest.mark.parametrize("mode", BAD_PACK_MODES)
+def test_malformed_pack_modes_refused_everywhere(mode):
+    # every surface that takes the knob refuses with the SAME loud
+    # species: the planner, the sweep service, the serve frontend,
+    # and the curator — never a silent fallback to first-fit
+    from timewarp_tpu.sweep.bucket import plan_buckets
+    from timewarp_tpu.sweep.spec import SweepConfigError
+    with pytest.raises(SweepConfigError):
+        plan_buckets([], pack_mode=mode)
+
+
+def test_good_pack_modes_validate():
+    from timewarp_tpu.pack.allocate import (PACK_MODES,
+                                            validate_pack_mode)
+    for mode in PACK_MODES:
+        assert validate_pack_mode(mode) == mode
+
+
+def test_pack_fit_refuses_absent_and_empty_ledgers(tmp_path):
+    # `pack fit` on nothing must be ONE actionable line, never a
+    # silent empty artifact (pack/cli.py)
+    from timewarp_tpu.pack.cli import pack_main
+    with pytest.raises(SystemExit) as ei:
+        pack_main(["fit", "--ledger", str(tmp_path / "nope")])
+    assert "index.jsonl" in str(ei.value) \
+        and "ledger add" in str(ei.value)
+    # a ledger that exists but holds no pack_stats rows is refused
+    # just as loudly
+    from timewarp_tpu.obs.ledger import RunLedger
+    led = tmp_path / "led"
+    RunLedger(str(led)).add_bench_line(
+        {"config": "x", "config_key": "x|cpu", "value": 1.0,
+         "schema": 2}, source="test")
+    with pytest.raises(SystemExit) as ei:
+        pack_main(["fit", "--ledger", str(led)])
+    assert "pack_stats" in str(ei.value)
+
+
+def test_pack_subcommand_usage_is_loud():
+    from timewarp_tpu.pack.cli import pack_main
+    with pytest.raises(SystemExit) as ei:
+        pack_main(["frobnicate"])
+    assert "usage" in str(ei.value)

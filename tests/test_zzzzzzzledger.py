@@ -233,6 +233,33 @@ def test_compare_rate_gate_and_spread_bands(tmp_path):
     assert compare_selections(led, "b0001", "b0004").to_json()["ok"]
 
 
+def test_compare_gates_packing_rollups(tmp_path):
+    """The packing rollups (sweep/journal.py util_rollup) ride the
+    bench line into the index and gate like rates: budget_efficiency
+    regresses DOWN, pad_waste_frac regresses UP; lines without the
+    fields stay inert."""
+    led = RunLedger(str(tmp_path / "led"))
+    led.add_bench_line(_line(100.0, budget_efficiency=0.80,
+                             pad_waste_frac=0.05), batch="b0001")
+    # efficiency collapses 40% -> a regression on that metric alone
+    led.add_bench_line(_line(100.0, budget_efficiency=0.48,
+                             pad_waste_frac=0.05), batch="b0002")
+    rep = compare_selections(led, "b0001", "b0002")
+    assert [d.metric for d in rep.regressions] == \
+        ["budget_efficiency"]
+    # pad waste balloons 10x -> lower-is-better gates on the INCREASE
+    led.add_bench_line(_line(100.0, budget_efficiency=0.80,
+                             pad_waste_frac=0.50), batch="b0003")
+    rep = compare_selections(led, "b0001", "b0003")
+    assert [d.metric for d in rep.regressions] == ["pad_waste_frac"]
+    # improvements never fail; rollup-less lines compare clean
+    led.add_bench_line(_line(100.0, budget_efficiency=0.95,
+                             pad_waste_frac=0.0), batch="b0004")
+    assert compare_selections(led, "b0001", "b0004").to_json()["ok"]
+    led.add_bench_line(_line(100.0), batch="b0005")
+    assert compare_selections(led, "b0001", "b0005").to_json()["ok"]
+
+
 def test_compare_join_and_selectors(tmp_path):
     led = RunLedger(str(tmp_path / "led"))
     led.add_bench_line(_line(config="gossip_100k"), batch="b0001")
@@ -496,7 +523,17 @@ def test_sweep_ingest_records_status_fields(tmp_path):
     assert rec["sweep"]["completed"] == 1
     assert rec["sweep"]["events"] == {"dispatch_decision": 0,
                                       "spec_rollback": 0,
-                                      "integrity_violation": 0}
+                                      "integrity_violation": 0,
+                                      "pack_decision": 0}
+    # the per-world (features, budget, supersteps) rows `pack fit`
+    # trains on (pack/predict.py training_rows) ride the ingest —
+    # every archived run is predictor history
+    [row] = rec["sweep"]["pack_stats"]
+    assert row["family"] == "token-ring" and row["budget"] == 60
+    assert 0 < row["supersteps"] <= 60
+    from timewarp_tpu.pack.predict import fit_from_ledger
+    art = fit_from_ledger(str(tmp_path / "led"))
+    assert art["rows"] == 1 and art["sha"]
     with pytest.raises(LedgerError, match="no sweep journal"):
         led.add_sweep(str(tmp_path / "empty"))
 

@@ -1,0 +1,508 @@
+"""Predictive bucket packing (timewarp_tpu/pack/, docs/sweeps.md +
+docs/serving.md "Predictive packing").
+
+Three laws pinned here:
+
+1. **Prediction purity** — ``predict_supersteps`` is a pure function
+   of ``(config, artifact)``; the artifact is sha-stamped and a
+   tampered file is refused loudly; with no artifact the forecast is
+   the budget (the honest fallback), never an invented number.
+2. **Plan purity + replay** — the first-fit plan is byte-identical to
+   the historical planner; the predicted plan journals one
+   ``pack_decision`` per bucket BEFORE any bucket starts, and a
+   kill→resume rebuilds the bucket plan from the journal alone
+   (no artifact needed). A first-fit journal refuses a ``--pack
+   predicted`` resume instead of silently re-bucketing in-flight
+   worlds.
+3. **The survival law is untouched** — packed or not, repacked or
+   not, straddling kill→resume or not, every streamed result stays
+   bit-identical to the solo run.
+
+Named with ten z's to sort after the serve suite (the 870 s tier-1
+window truncates; new tests must not displace existing dots).
+"""
+
+import json
+
+import pytest
+
+from timewarp_tpu.pack import (PACK_MODE_GRAMMAR, PackFitError,
+                               feature_key, fit_rows, load_artifact,
+                               predict_supersteps, predicted_order,
+                               save_artifact, training_rows,
+                               validate_pack_mode)
+from timewarp_tpu.serve.curator import CuratorKilled, ServeCurator
+from timewarp_tpu.serve.frontend import ServeFrontend, bucket_key_sha
+from timewarp_tpu.serve.worker import OpenBucketRunner
+from timewarp_tpu.sweep import (SweepConfigError, SweepJournal,
+                                SweepPack, SweepService, plan_buckets,
+                                solo_result)
+from timewarp_tpu.sweep.journal import SweepJournalError, util_rollup
+from timewarp_tpu.sweep.service import SweepKilled
+from timewarp_tpu.sweep.spec import RunConfig, resolve_window
+
+# -- fixtures --------------------------------------------------------------
+
+_RING = {"nodes": 20, "n_tokens": 3, "think_us": 2000, "end_us": 70000,
+         "mailbox_cap": 8}
+
+#: one shape group, three budgets — with max_bucket=2 the packing
+#: order decides who shares an executable, which is the decision the
+#: predicted planner must journal and replay
+PACK = SweepPack.from_json([
+    {"id": "ring-a", "scenario": "token-ring", "params": _RING,
+     "link": "uniform:1000:5000", "seed": 0, "budget": 60},
+    {"id": "ring-b", "scenario": "token-ring", "params": _RING,
+     "link": "uniform:2000:7000", "seed": 3, "budget": 90},
+    {"id": "ring-c", "scenario": "token-ring", "params": _RING,
+     "link": "uniform:1000:5000", "seed": 7, "budget": 25},
+])
+
+_SOLO = {}
+
+
+def solo(cfg):
+    if cfg.run_id not in _SOLO:
+        _SOLO[cfg.run_id] = solo_result(cfg, lint="off")
+    return _SOLO[cfg.run_id]
+
+
+def assert_survival_law(pack, report):
+    assert report.ok, report.to_json()
+    for rid, res in report.done.items():
+        assert solo(pack.by_id(rid)) == res, (
+            f"survival law violated for {rid}:\n"
+            f"  solo:     {solo(pack.by_id(rid))}\n  streamed: {res}")
+
+
+SERVE_RING = {"nodes": 64, "n_tokens": 4, "think_us": 2000,
+              "end_us": 1 << 40, "mailbox_cap": 8}
+
+
+def _scfg(i, seed, budget, faults=None, speculate=None,
+          link="uniform:1000:5000"):
+    d = {"id": f"w{i}", "scenario": "token-ring", "params": SERVE_RING,
+         "link": link, "seed": seed, "budget": budget}
+    if faults:
+        d["faults"] = faults
+    if speculate:
+        d["speculate"] = speculate
+    return d
+
+
+def _event_index(scan, **match):
+    for i, e in enumerate(scan.events):
+        if all(e.get(k) == v for k, v in match.items()):
+            return i
+    raise AssertionError(f"no event matching {match}")
+
+
+# -- the predictor ---------------------------------------------------------
+
+def test_fit_is_deterministic_and_backoff_is_nested():
+    done = {"ring-a": {"supersteps": 30}, "ring-b": {"supersteps": 45}}
+    rows = training_rows(PACK.configs, done)
+    # ring-c has no result: skipped, never invented
+    assert [r["supersteps"] for r in rows] == [30, 45]
+    art1, art2 = fit_rows(rows), fit_rows(list(reversed(rows)))
+    assert art1["sha"] == art2["sha"], \
+        "coefficients must depend on the row multiset, not row order"
+    a, b, c = PACK.configs
+    # exact key: ring-a realized 30/60 -> forecast 0.5 x budget
+    assert predict_supersteps(a, art1) == 30
+    assert predict_supersteps(b, art1) == 45
+    # ring-c's key was never seen -> family mean fraction 0.5 -> 12
+    assert predict_supersteps(c, art1) == round(0.5 * 25)
+    # family backoff falls through to global for an unseen family
+    g = SweepPack.from_json([
+        {"id": "g", "scenario": "gossip", "params": {"nodes": 8},
+         "link": "fixed:1000", "budget": 100}]).configs[0]
+    assert predict_supersteps(g, art1) == 50
+    # the honest fallback: no artifact -> the budget, exactly
+    for cfg in PACK.configs:
+        assert predict_supersteps(cfg, None) == cfg.budget
+
+
+def test_predict_clamps_to_budget_and_one():
+    # a fraction rounding to 0 clamps to 1; a fraction of 1.0 (or a
+    # label above budget, truncated at fit time) clamps to budget
+    rows = [{"key": "k", "family": "f", "budget": 100,
+             "supersteps": 100}]
+    art = fit_rows(rows)
+    tiny = fit_rows([{"key": "k", "family": "f", "budget": 1000,
+                      "supersteps": 1}])
+    a = PACK.configs[0]
+    assert 1 <= predict_supersteps(a, tiny) <= a.budget
+    assert predict_supersteps(a, art) == a.budget
+
+
+def test_artifact_sha_tamper_is_refused(tmp_path):
+    done = {"ring-a": {"supersteps": 30}}
+    art = fit_rows(training_rows(PACK.configs, done))
+    p = str(tmp_path / "pred.json")
+    assert save_artifact(art, p) == art["sha"]
+    assert load_artifact(p)["sha"] == art["sha"]
+    # flip one coefficient after fitting: the sha check refuses
+    doc = json.loads(open(p).read())
+    doc["global"]["fraction"] = 0.01
+    with open(p, "w") as f:
+        json.dump(doc, f)
+    with pytest.raises(ValueError, match="FAILED its sha check"):
+        load_artifact(p)
+    with open(p, "w") as f:
+        json.dump({"artifact": "something-else"}, f)
+    with pytest.raises(ValueError, match="not a timewarp-pack"):
+        load_artifact(p)
+    with pytest.raises(PackFitError, match="no per-world training"):
+        fit_rows([])
+
+
+# -- the planner -----------------------------------------------------------
+
+def test_first_fit_plan_is_byte_identical_to_historical():
+    base = plan_buckets(PACK.configs, max_bucket=2)
+    ff = plan_buckets(PACK.configs, max_bucket=2,
+                      pack_mode="first-fit")
+    assert [(b.bucket_id, b.run_ids) for b in base] == \
+        [(b.bucket_id, b.run_ids) for b in ff]
+    # pack order, chunked: [a, b], [c]
+    assert [b.run_ids for b in base] == \
+        [("ring-a", "ring-b"), ("ring-c",)]
+
+
+def test_predicted_plan_sorts_each_group_by_forecast():
+    plan = plan_buckets(PACK.configs, max_bucket=2,
+                        pack_mode="predicted",
+                        predict=lambda c: c.budget)
+    # descending forecast: [b(90), a(60)], [c(25)] — like horizons
+    # share an executable, the short world gets its own
+    assert [b.run_ids for b in plan] == \
+        [("ring-b", "ring-a"), ("ring-c",)]
+    order = predicted_order(PACK.configs, lambda c: c.budget)
+    assert [c.run_id for c in order] == ["ring-b", "ring-a", "ring-c"]
+    # ties keep pack order (stable sort -> plan purity)
+    flat = predicted_order(PACK.configs, lambda c: 7)
+    assert [c.run_id for c in flat] == ["ring-a", "ring-b", "ring-c"]
+    with pytest.raises(SweepConfigError, match="grammar"):
+        plan_buckets(PACK.configs, pack_mode="best-fit")
+    assert validate_pack_mode("predicted") == "predicted"
+    assert PACK_MODE_GRAMMAR == "first-fit | predicted"
+
+
+# -- the service: predicted packing under the survival law -----------------
+
+def test_sweep_predicted_pack_journals_decisions_before_effect(
+        tmp_path):
+    jd = str(tmp_path / "p1")
+    svc = SweepService(PACK, jd, chunk=16, lint="off", max_bucket=2,
+                       pack_mode="predicted")
+    report = svc.run()
+    assert_survival_law(PACK, report)
+    scan = SweepJournal(jd).scan()
+    # one pack_decision per bucket, all journaled BEFORE any bucket
+    # ran a chunk — resume must never need the artifact to re-plan
+    assert len(scan.pack_plan) == report.buckets == 2
+    assert {tuple(d["members"]) for d in scan.pack_plan.values()} == \
+        {("ring-b", "ring-a"), ("ring-c",)}
+    last_decision = max(
+        i for i, e in enumerate(scan.events)
+        if e.get("ev") == "pack_decision")
+    first_start = min(
+        i for i, e in enumerate(scan.events)
+        if e.get("ev") == "bucket_start")
+    assert last_decision < first_start, \
+        "pack_decision must be journaled before its effect"
+    assert scan.event_counts()["pack_decision"] == 2
+    roll = util_rollup(scan.util)
+    assert 0.0 < roll["budget_efficiency"] <= 1.0
+    assert 0.0 <= roll["pad_waste_frac"] < 1.0
+
+
+def test_sweep_predicted_kill_resume_replays_the_journaled_plan(
+        tmp_path):
+    jd = str(tmp_path / "p2")
+    svc = SweepService(PACK, jd, chunk=16, lint="off", max_bucket=2,
+                       pack_mode="predicted", inject="die:2")
+    with pytest.raises(SweepKilled):
+        svc.run()
+    mid = SweepJournal(jd).scan()
+    assert len(mid.pack_plan) == 2, \
+        "the full plan must be journaled before the first chunk"
+    assert len(mid.done) < len(PACK.configs)
+    # resume with the DEFAULT mode and no artifact: the journaled
+    # pack_decision records alone must reproduce the predicted plan
+    svc2 = SweepService.resume(jd, chunk=16, lint="off")
+    report = svc2.run()
+    assert_survival_law(PACK, report)
+    scan = SweepJournal(jd).scan()
+    assert {bid: tuple(d["members"])
+            for bid, d in scan.pack_plan.items()} == \
+        {bid: tuple(d["members"]) for bid, d in mid.pack_plan.items()}
+    # replay, not re-planning: no pack_decision was re-journaled
+    assert scan.event_counts()["pack_decision"] == 2
+    ids = [e["result"]["run_id"] for e in scan.events
+           if e.get("ev") == "world_done"]
+    assert sorted(ids) == sorted(set(ids)) == \
+        sorted(c.run_id for c in PACK.configs)
+
+
+def test_resume_refuses_to_cross_first_fit_journal_with_predicted(
+        tmp_path):
+    jd = str(tmp_path / "p3")
+    svc = SweepService(PACK, jd, chunk=16, lint="off", max_bucket=2,
+                       inject="die:2")
+    with pytest.raises(SweepKilled):
+        svc.run()
+    with pytest.raises(SweepJournalError, match="planned first-fit"):
+        SweepService.resume(jd, chunk=16, lint="off",
+                            pack_mode="predicted").run()
+    # ...while a first-fit resume of the same journal just works
+    # (first-fit plans are pure functions of (pack, max_bucket), so
+    # the resume must re-state the same max_bucket — no pack_decision
+    # records exist to replay from)
+    report = SweepService.resume(jd, chunk=16, lint="off",
+                                 max_bucket=2).run()
+    assert_survival_law(PACK, report)
+
+
+# -- plan lint: TW606 ------------------------------------------------------
+
+def test_plan_lint_tw606_flags_first_fit_occupancy_skew():
+    from timewarp_tpu.analysis import lint_pack_json
+    base = {"scenario": "gossip", "params": {"nodes": 16},
+            "link": "fixed:1000"}
+    n, rep = lint_pack_json([
+        {**base, "id": "long", "budget": 1000},
+        {**base, "id": "short", "seed": 1, "budget": 10},
+    ])
+    assert rep.ok                       # a warning, not a refusal
+    tw606 = [f for f in rep.warnings if f.code == "TW606"]
+    assert len(tw606) == 1
+    assert "--pack predicted" in tw606[0].message
+    assert "budget-masked" in tw606[0].message
+    # a like-horizoned bucket is clean; so is a solo bucket
+    n, rep2 = lint_pack_json([
+        {**base, "id": "a", "budget": 1000},
+        {**base, "id": "b", "seed": 1, "budget": 900},
+    ])
+    assert not [f for f in rep2.warnings if f.code == "TW606"]
+    n, rep3 = lint_pack_json([{**base, "id": "only", "budget": 10}])
+    assert not [f for f in rep3.warnings if f.code == "TW606"]
+
+
+# -- serve: predicted placement -------------------------------------------
+
+def test_frontend_predicted_placement_journals_before_admit(tmp_path):
+    journal = SweepJournal(str(tmp_path), host="a")
+    front = ServeFrontend(journal, "a", ("127.0.0.1", 1), slots=2,
+                          pack_mode="predicted")
+    rid0, bid0, _ = front.admit(_scfg(0, 0, 96))
+    rid1, bid1, _ = front.admit(_scfg(1, 3, 64))
+    assert (bid0, bid1) == ("sb0", "sb0")
+    # capacity 2 exhausted: the third same-key admit opens sb1, and
+    # its decision FORECAST that bucket id before the bucket existed
+    rid2, bid2, _ = front.admit(_scfg(2, 5, 32))
+    assert bid2 == "sb1"
+    scan = SweepJournal(str(tmp_path)).scan()
+    places = [e for e in scan.pack_decisions
+              if e.get("kind") == "place"]
+    assert [p["run_id"] for p in places] == ["w0", "w1", "w2"]
+    assert [p["bucket"] for p in places] == ["sb0", "sb0", "sb1"]
+    # no artifact: every forecast is the honest budget fallback
+    assert [p["predicted"] for p in places] == [96, 64, 32]
+    assert places[1]["horizon"] == 96   # sb0's longest member
+    for p in places:
+        assert _event_index(scan, ev="pack_decision",
+                            run_id=p["run_id"]) < \
+            _event_index(scan, ev="admit", run_id=p["run_id"]), \
+            "placement decision must be journaled before the admit"
+    # first-fit frontends journal NO pack decisions (plan purity)
+    j2 = SweepJournal(str(tmp_path / "ff"), host="a")
+    f2 = ServeFrontend(j2, "a", ("127.0.0.1", 1), slots=2)
+    f2.admit(_scfg(0, 0, 96))
+    assert not SweepJournal(str(tmp_path / "ff")).scan().pack_decisions
+
+
+def test_frontend_predicted_picks_best_horizon_bucket(tmp_path):
+    """Two same-key open buckets with free slots (the state a repack
+    or a resume leaves behind): first-fit takes the FIRST with space;
+    predicted joins the one whose forecast remaining horizon matches
+    the config's own forecast."""
+    def seed(root):
+        j = SweepJournal(root, host="a")
+        for bid, cfg in (("sb0", RunConfig.from_json(_scfg(0, 0, 96),
+                                                     0)),
+                         ("sb1", RunConfig.from_json(_scfg(1, 3, 8),
+                                                     0))):
+            j.append({"ev": "bucket_open", "bucket": bid,
+                      "key": bucket_key_sha(cfg), "capacity": 4,
+                      "window": resolve_window(cfg)})
+            j.append({"ev": "admit", "run_id": cfg.run_id,
+                      "bucket": bid, "slot": 0,
+                      "config": cfg.to_json()})
+        return j
+    ff = ServeFrontend(seed(str(tmp_path / "ff")), "a",
+                       ("127.0.0.1", 1), slots=4)
+    assert ff.admit(_scfg(2, 5, 8))[1] == "sb0"
+    # the same admission, predicted: an 8-budget config forecasts 8
+    # — sb1's remaining horizon (8) matches exactly, while sb0 (96)
+    # would pin it budget-masked behind a long fleet's pow2 pad
+    pr = ServeFrontend(seed(str(tmp_path / "pr")), "a",
+                       ("127.0.0.1", 1), slots=4,
+                       pack_mode="predicted")
+    assert pr.admit(_scfg(2, 5, 8))[1] == "sb1"
+    scan = SweepJournal(str(tmp_path / "pr")).scan()
+    place = [e for e in scan.pack_decisions
+             if e.get("run_id") == "w2"]
+    assert len(place) == 1 and place[0]["bucket"] == "sb1"
+    assert place[0]["predicted"] == 8 and place[0]["horizon"] == 8
+
+
+# -- serve: merge_from edge cases -----------------------------------------
+
+def test_merge_refuses_wider_donor_pad_and_accepts_reverse(tmp_path):
+    """An in-flight restart ledger never shrinks: a donor whose
+    realized fault pad is wider than the merged fleet needs is
+    refused LOUDLY; merging the narrow bucket into the wide one —
+    the documented fix — preserves the survival law."""
+    journal = SweepJournal(str(tmp_path), host="a")
+    done = {}
+    c_f = RunConfig.from_json(
+        _scfg(0, 0, 16, faults="crash:3:5ms:40ms:reset"), 0)
+    c_p = RunConfig.from_json(_scfg(1, 3, 96), 0)
+    c_n = RunConfig.from_json(_scfg(2, 5, 64), 0)
+    w = resolve_window(c_p)
+    wide = OpenBucketRunner("sb0", journal, done, capacity=3,
+                            window=w, chunk=8)
+    wide.admit(0, c_f)
+    wide.admit(1, c_p)
+    while c_f.run_id not in done:
+        assert wide.step() == "running"
+    assert wide.min_pad[0] >= 1, "fault pad must stay realized"
+    narrow = OpenBucketRunner("sb1", journal, done, capacity=2,
+                              window=w, chunk=8)
+    narrow.admit(0, c_n)
+    assert narrow.step() == "running"   # mid-flight, pad (0,0,0)
+    with pytest.raises(ValueError, match="never shrinks"):
+        narrow.merge_from(wide)
+    # the reverse direction is the documented fix
+    moved = wide.merge_from(narrow)
+    assert moved == ["w2"]
+    while wide.step() == "running":
+        pass
+    for cfg in (c_f, c_p, c_n):
+        want = solo_result(cfg, lint="off")
+        assert want == done[cfg.run_id], (
+            f"repack broke the survival law for {cfg.run_id}:\n"
+            f"  solo:     {want}\n  streamed: {done[cfg.run_id]}")
+
+
+def test_merge_carries_inflight_speculation_chain(tmp_path):
+    """Repack under an in-flight speculation chain: the moved world's
+    committed decision chain splices over, keeps growing in the new
+    bucket, and the final record's chain starts with the pre-merge
+    prefix — a verify twin can still replay it end to end. The result
+    stays bit-identical to the same world run WITHOUT the repack
+    (solo_result refuses speculate configs — the no-repack bucket run
+    is the reference twin here)."""
+    c_s = RunConfig.from_json(
+        _scfg(0, 0, 96, speculate="fixed:6000"), 0)
+    w = resolve_window(c_s)
+    (tmp_path / "ref").mkdir()
+    (tmp_path / "re").mkdir()
+    ref_done = {}
+    ref = OpenBucketRunner(
+        "sb0", SweepJournal(str(tmp_path / "ref"), host="a"),
+        ref_done, capacity=2, window=w, chunk=8)
+    ref.admit(0, c_s)
+    while ref.step() == "running":
+        pass
+    journal = SweepJournal(str(tmp_path / "re"), host="a")
+    done = {}
+    r1 = OpenBucketRunner("sb1", journal, done, capacity=2,
+                          window=w, chunk=8)
+    r1.admit(0, c_s)
+    assert r1.step() == "running"
+    assert r1.step() == "running"
+    pre = [dict(d) for d in r1.spec_chains[0]]
+    assert pre, "the chain must be in flight before the repack"
+    r0 = OpenBucketRunner("sb0", journal, done, capacity=2,
+                          window=w, chunk=8)
+    assert r0.merge_from(r1) == ["w0"]
+    assert r0.spec_chains[0] == pre
+    while r0.step() == "running":
+        pass
+    assert len(r0.spec_chains[0]) >= len(pre)
+    assert r0.spec_chains[0][:len(pre)] == pre
+    scan = SweepJournal(str(tmp_path / "re")).scan()
+    rec = next(e for e in scan.events if e.get("ev") == "world_done"
+               and e["result"]["run_id"] == "w0")
+    assert rec["spec_chain"] == r0.spec_chains[0]
+    assert ref_done["w0"] == done["w0"], (
+        "repack under speculation broke the survival law:\n"
+        f"  no-repack: {ref_done['w0']}\n  repacked:  {done['w0']}")
+
+
+def test_serve_repack_straddles_kill_resume(tmp_path):
+    """A predicted-mode curator journals the repack decision, merges
+    the under-occupied donor, then dies mid-bucket; the resumed
+    incarnation finishes from the checkpoint — results ≡ solo,
+    exactly one world_done each, decision before effect."""
+    root = str(tmp_path)
+    ja = SweepJournal(root, host="a")
+    c0 = RunConfig.from_json(_scfg(0, 0, 96), 0)
+    c1 = RunConfig.from_json(_scfg(1, 3, 96), 0)
+    for bid, cfg in (("sb0", c0), ("sb1", c1)):
+        ja.append({"ev": "bucket_open", "bucket": bid,
+                   "key": bucket_key_sha(cfg), "capacity": 4,
+                   "window": resolve_window(cfg)})
+        ja.append({"ev": "admit", "run_id": cfg.run_id,
+                   "bucket": bid, "slot": 0,
+                   "config": cfg.to_json()})
+    ja.append({"ev": "serve_drain", "host": "a"})
+    with pytest.raises(CuratorKilled):
+        ServeCurator(root, "a", chunk=8, lease_ttl_s=60.0,
+                     journal=ja, pack_mode="predicted",
+                     die_after_chunks=4).run(max_seconds=180)
+    ja.close()
+    mid = SweepJournal(root).scan()
+    assert mid.repacks and mid.repacks[0]["moved"] == ["w1"], \
+        "the kill must land AFTER the repack"
+    assert len(mid.done) < 2, "the kill must land mid-bucket"
+    # own-name lease reclaim: resume immediately, default mode — the
+    # journaled membership alone must carry the repack forward
+    ServeCurator(root, "a", chunk=8,
+                 lease_ttl_s=60.0).run(max_seconds=240)
+    scan = SweepJournal(root).scan()
+    assert sorted(scan.done) == ["w0", "w1"]
+    for cfg in (c0, c1):
+        want = solo_result(cfg, lint="off")
+        assert want == scan.done[cfg.run_id], (
+            f"kill-straddling repack broke survival for "
+            f"{cfg.run_id}:\n  solo:     {want}\n"
+            f"  streamed: {scan.done[cfg.run_id]}")
+    ids = sorted(e["result"]["run_id"] for e in scan.events
+                 if e.get("ev") == "world_done")
+    assert ids == ["w0", "w1"], "double-run across the kill boundary"
+    dec = [e for e in scan.pack_decisions
+           if e.get("kind") == "repack"]
+    assert len(dec) == 1 and dec[0]["bucket"] == "sb1" \
+        and dec[0]["into"] == "sb0"
+    assert dec[0]["predicted_occupancy"] <= 0.5
+    assert _event_index(scan, ev="pack_decision", kind="repack") < \
+        _event_index(scan, ev="repack"), \
+        "the repack decision must be journaled before its effect"
+    assert "sb1" in scan.bucket_done
+
+
+def test_feature_key_is_canonical_and_loud():
+    a = PACK.configs[0]
+    assert feature_key(a) == feature_key(a)
+    assert feature_key(a) != feature_key(PACK.configs[1])
+    k = json.loads(feature_key(a))
+    assert k["family"] == "token-ring" and k["nodes"] == 20
+    bad = SweepPack.from_json([
+        {"id": "x", "scenario": "gossip", "link": "bogus:1",
+         "params": {"nodes": 8}}]).configs[0]
+    with pytest.raises(SweepConfigError):
+        feature_key(bad)

@@ -39,6 +39,14 @@ This module mirrors those decisions without building a single engine:
   compiles at the first world's pad — a later, wider schedule forces
   the rebuild the r20 zero-recompile contract exists to prevent.
   Front-load the widest schedule (or pre-pad with ``pad``).
+- **TW606** (warning) — occupancy skew under first-fit packing: a
+  bucket whose forecast per-world supersteps (declared budgets — the
+  honest no-artifact predictor, timewarp_tpu/pack/predict.py) spread
+  wider than :data:`TW606_SPREAD`. Short worlds quiesce early and
+  their slots idle budget-masked while every chunk still pays the
+  longest member's pow2 scan pad; ``--pack predicted`` re-sorts each
+  shape group best-fit-decreasing to equalize per-bucket quiescence
+  horizons (docs/sweeps.md "Predictive packing").
 
 Per config, the plan lint also runs the scenario sanitizer the
 engines would (jaxpr contract + capacity, cached per family/params),
@@ -65,7 +73,13 @@ from .capacity import lint_capacity_faulted
 from .report import ERROR, INFO, WARNING, Finding, LintReport
 
 __all__ = ["lint_run_config", "lint_pack", "lint_pack_json",
-           "lint_pack_path"]
+           "lint_pack_path", "TW606_SPREAD"]
+
+#: TW606 threshold: warn when a first-fit bucket's forecast
+#: supersteps spread (1 - shortest/longest) exceeds this — i.e. the
+#: shortest member is forecast to finish in under half the longest
+#: member's horizon, leaving its slot budget-masked for the rest
+TW606_SPREAD = 0.5
 
 
 @lru_cache(maxsize=64)
@@ -199,8 +213,9 @@ def lint_run_config(cfg: RunConfig, *, deep: bool = True) -> LintReport:
 
 def lint_pack(pack: SweepPack, *, max_bucket: int = 64) -> LintReport:
     """The whole pre-flight for a parsed pack: per-config rules
-    (:func:`lint_run_config`), the predicted bucket plan (TW601), and
-    the pad-growth rebuild warning (TW605)."""
+    (:func:`lint_run_config`), the predicted bucket plan (TW601), the
+    pad-growth rebuild warning (TW605), and the first-fit occupancy
+    skew warning (TW606)."""
     from ..sweep.bucket import plan_buckets
     rep = LintReport()
     plannable: List[RunConfig] = []
@@ -238,6 +253,22 @@ def lint_pack(pack: SweepPack, *, max_bucket: int = 64) -> LintReport:
                     "(docs/serving.md). Front-load the widest "
                     "schedule or pre-pad the earlier worlds"))
             high = tuple(max(x, h) for x, h in zip(r, high))
+    from ..pack.predict import predict_supersteps
+    for b in buckets:
+        if b.B < 2:
+            continue
+        preds = [predict_supersteps(c, None) for c in b.configs]
+        spread = 1.0 - (min(preds) / max(preds))
+        if spread > TW606_SPREAD:
+            rep.add(Finding(
+                "TW606", WARNING, f"bucket {b.bucket_id}",
+                f"first-fit occupancy skew: forecast supersteps span "
+                f"{min(preds)}..{max(preds)} (spread "
+                f"{spread:.0%} > {TW606_SPREAD:.0%}) — short worlds "
+                "quiesce early and idle budget-masked while every "
+                "chunk pays the longest member's pow2 scan pad; "
+                "re-plan with `--pack predicted` (docs/sweeps.md "
+                "'Predictive packing')"))
     widths = [b.B for b in buckets]
     windows = sorted({b.window for b in buckets})
     pad_note = ", ".join(

@@ -661,6 +661,12 @@ def main(argv=None) -> int:
         # results back as worlds quiesce (serve/, docs/serving.md)
         from .serve.cli import submit_main
         return submit_main(argv[1:])
+    if argv and argv[0] == "pack":
+        # fit the predictive-packing superstep forecaster from
+        # run-ledger history (pack/, docs/sweeps.md "Predictive
+        # packing")
+        from .pack.cli import pack_main
+        return pack_main(argv[1:])
     if argv and argv[0] == "profile":
         # full-telemetry run + Perfetto trace (docs/observability.md)
         return profile_main(argv[1:])

@@ -288,8 +288,12 @@ class RunLedger:
         # admission throughput (bench.py serve_gossip — gateable now
         # that its causal explanation, the engine_builds/compiles
         # counters, rides the same line)
+        # budget_efficiency / pad_waste_frac are the packing rollups
+        # (sweep/journal.py util_rollup) the predictive-packing gate
+        # compares (docs/sweeps.md "Predictive packing")
         for f in ("value", "min", "max", "reps", "seconds",
-                  "admit_per_s"):
+                  "admit_per_s", "budget_efficiency",
+                  "pad_waste_frac"):
             if isinstance(line.get(f), (int, float)) \
                     and not isinstance(line.get(f), bool):
                 rec[f] = line[f]
@@ -379,6 +383,18 @@ class RunLedger:
             or (sorted(scan.hosts) or ["?"])[0]
         os.makedirs(self.runs_dir, exist_ok=True)
         fields = status_fields(scan, len(scan.admits))
+        # (features, budget, supersteps) training rows for the
+        # packing forecaster (pack/predict.py) — assembled at ingest
+        # so `pack fit` reads the index alone, never the journals
+        from ..sweep.spec import RunConfig, SweepConfigError
+        cfgs = []
+        for a in scan.admits.values():
+            try:
+                cfgs.append(RunConfig.from_json(dict(a["config"]), 0))
+            except (SweepConfigError, KeyError, TypeError):
+                continue
+        from ..pack.predict import training_rows
+        pack_stats = training_rows(cfgs, scan.done)
         rec = {
             "ledger_schema": LEDGER_SCHEMA,
             "run_id": self._next_run_id(),
@@ -397,6 +413,7 @@ class RunLedger:
                 "hosts": fields.get("hosts", {}),
                 "events": scan.event_counts(),
                 "utilization": scan.util,
+                "pack_stats": pack_stats,
             },
         }
         return self._commit(rec)
@@ -415,11 +432,23 @@ class RunLedger:
                 "(no journal.jsonl)")
         scan = j.scan()
         total = None
+        pack_stats = []
         if os.path.exists(j.pack_path):
             with open(j.pack_path) as f:
                 total = len(json.load(f))
+            # forecaster training rows (pack/predict.py), assembled
+            # at ingest so `pack fit` reads the index alone
+            from ..pack.predict import training_rows
+            from ..sweep.spec import SweepPack
+            try:
+                pack_stats = training_rows(
+                    SweepPack.load(j.pack_path).configs, scan.done)
+            except Exception:  # noqa: BLE001 — archival best-effort
+                pack_stats = []
         os.makedirs(self.runs_dir, exist_ok=True)
         sha = scan.pack_sha or "unpacked"
+        sweep_fields = status_fields(scan, total)
+        sweep_fields["pack_stats"] = pack_stats
         rec = {
             "ledger_schema": LEDGER_SCHEMA,
             "run_id": self._next_run_id(),
@@ -428,7 +457,7 @@ class RunLedger:
             "config_key": f"sweep|{sha[:12]}",
             "git_sha": resolve_git_sha(journal_dir),
             "source": os.path.abspath(journal_dir),
-            "sweep": status_fields(scan, total),
+            "sweep": sweep_fields,
         }
         return self._commit(rec)
 

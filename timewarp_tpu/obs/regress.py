@@ -63,7 +63,13 @@ _METRICS = {"value": ("higher", "rate_gate"),
             # serving admission throughput (bench.py serve_gossip):
             # present only on serve lines; _compare_one skips metrics
             # missing on either side, so every other config is inert
-            "admit_per_s": ("higher", "rate_gate")}
+            "admit_per_s": ("higher", "rate_gate"),
+            # packing rollups (sweep/journal.py util_rollup,
+            # docs/sweeps.md "Predictive packing"): present only on
+            # sweep_hetero / serve_gossip bench lines — efficiency
+            # regresses DOWN, pad waste regresses UP
+            "budget_efficiency": ("higher", "rate_gate"),
+            "pad_waste_frac": ("lower", "rate_gate")}
 
 
 @dataclass

@@ -87,6 +87,18 @@ def _serve(argv) -> int:
     p.add_argument("--no-repack", action="store_true",
                    help="disable the between-chunk merge of "
                         "under-occupied same-key open buckets")
+    p.add_argument("--pack", default="first-fit", dest="pack_mode",
+                   help="slot placement / repack policy (first-fit | "
+                        "predicted; docs/serving.md 'Predictive "
+                        "packing'): predicted places each admission "
+                        "in the open bucket whose forecast remaining "
+                        "horizon best matches it, and repacks when "
+                        "PREDICTED occupancy falls under the floor — "
+                        "every choice journaled as a pack_decision")
+    p.add_argument("--pack-artifact", default=None,
+                   help="sha-stamped predictor artifact from "
+                        "`timewarp-tpu pack fit` (predicted mode "
+                        "falls back to declared budgets without one)")
     p.add_argument("--max-seconds", type=float, default=None,
                    help="hard deadline: exit even if not drained")
     p.add_argument("--die-after-chunks", type=int, default=None,
@@ -100,6 +112,15 @@ def _serve(argv) -> int:
         raise SystemExit("--no-curator without --listen would serve "
                          "nothing and execute nothing")
 
+    # validate the knob (and load + sha-check the artifact) ONCE,
+    # loudly, before any journal record exists
+    from ..pack.allocate import validate_pack_mode
+    validate_pack_mode(args.pack_mode)
+    artifact = None
+    if args.pack_artifact is not None:
+        from ..pack.predict import load_artifact
+        artifact = load_artifact(args.pack_artifact)
+
     journal = SweepJournal(args.journal, host=me.name)
     cur: Optional[ServeCurator] = None
     if not args.no_curator:
@@ -107,7 +128,8 @@ def _serve(argv) -> int:
             args.journal, me.name, chunk=args.chunk, lint=args.lint,
             lease_ttl_s=args.lease_ttl_s, poll_s=args.poll_s,
             heartbeat_s=args.heartbeat_s, repack=not args.no_repack,
-            die_after_chunks=args.die_after_chunks, journal=journal)
+            die_after_chunks=args.die_after_chunks, journal=journal,
+            pack_mode=args.pack_mode, pack_artifact=artifact)
 
     if args.listen is None:
         # curator-only host: the claim loop IS the process
@@ -131,7 +153,8 @@ def _serve(argv) -> int:
     from ..net.transfer import Transport
     from .frontend import ServeFrontend
     front = ServeFrontend(journal, me.name, listen, slots=args.slots,
-                          lint=args.lint)
+                          lint=args.lint, pack_mode=args.pack_mode,
+                          pack_artifact=artifact)
     worker = None
     killed: List[BaseException] = []
     if cur is not None:

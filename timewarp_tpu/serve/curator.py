@@ -58,7 +58,9 @@ class ServeCurator:
                  heartbeat_s: float = 1.0, repack: bool = True,
                  repack_below: float = 0.5, max_attempts: int = 3,
                  die_after_chunks: Optional[int] = None,
-                 journal: Optional[SweepJournal] = None) -> None:
+                 journal: Optional[SweepJournal] = None,
+                 pack_mode: str = "first-fit",
+                 pack_artifact=None) -> None:
         # the embedded curator shares the frontend's journal handle
         # (append is locked) so one host's seq stamps stay unique
         self.journal = journal if journal is not None \
@@ -71,6 +73,21 @@ class ServeCurator:
         self.heartbeat_s = float(heartbeat_s)
         self.repack = bool(repack)
         self.repack_below = float(repack_below)
+        #: proactive repack policy (docs/serving.md "Predictive
+        #: packing"): "predicted" ALSO triggers a merge when the
+        #: donor's forecast remaining occupancy — predicted work left,
+        #: not heads admitted — falls under ``repack_below``, so a
+        #: bucket of nearly-quiesced worlds drains into a live one
+        #: before its slots sit budget-masked
+        from ..pack.allocate import validate_pack_mode
+        self.pack_mode = validate_pack_mode(pack_mode)
+        self.pack_artifact = None
+        if pack_artifact is not None:
+            if isinstance(pack_artifact, str):
+                from ..pack.predict import load_artifact
+                self.pack_artifact = load_artifact(pack_artifact)
+            else:
+                self.pack_artifact = dict(pack_artifact)
         #: chunk-executor call counter + the injected-death threshold
         #: (counted across the whole curator lifetime, 1-based like
         #: the sweep InjectPlan's K)
@@ -160,6 +177,38 @@ class ServeCurator:
         runner.restore()
         return runner
 
+    def _predicted_occupancy(self, bid: str, donor_active,
+                             scan: JournalState,
+                             capacity: int) -> Optional[float]:
+        """Forecast remaining occupancy of a donor bucket: remaining
+        work (forecast supersteps minus checkpointed progress, per
+        active world) over the work the bucket's slots will PAY for
+        (capacity x its longest remaining member — the pow2 scan runs
+        every slot until the slowest world drains). Near 0.0 the
+        bucket's slots are about to idle budget-masked even though
+        heads still occupy them — the proactive trigger the observed
+        head-count occupancy cannot see. None when nothing is active
+        (the head-count trigger already fires there)."""
+        from ..pack.predict import predict_supersteps
+        from .worker import checkpoint_meta
+        if not donor_active:
+            return None
+        done_ss: Dict[str, int] = {}
+        meta = checkpoint_meta(self.journal.checkpoint_path(bid))
+        if meta is not None:
+            done_ss = dict(zip(meta.get("members", ()),
+                               meta.get("supersteps", ())))
+        rem = []
+        for rid in donor_active:
+            cfg = RunConfig.from_json(
+                dict(scan.admits[rid]["config"]), 0)
+            rem.append(max(0, predict_supersteps(
+                cfg, self.pack_artifact) - int(done_ss.get(rid, 0))))
+        longest = max(rem)
+        if longest <= 0:
+            return 0.0
+        return sum(rem) / (capacity * longest)
+
     def _try_repack(self, runner: OpenBucketRunner, lease: Lease,
                     scan: JournalState) -> None:
         """The re-packing pass (module docstring): pull one
@@ -178,13 +227,35 @@ class ServeCurator:
                 if a.get("bucket") == bid and rid not in scan.done
                 and rid not in scan.failed]
             occ = len(donor_active) / max(1, int(meta["capacity"]))
-            if occ > self.repack_below \
+            pocc = None
+            if self.pack_mode == "predicted":
+                pocc = self._predicted_occupancy(
+                    bid, donor_active, scan,
+                    max(1, int(meta["capacity"])))
+            under = occ <= self.repack_below or (
+                pocc is not None and pocc <= self.repack_below)
+            if not under \
                     or len(donor_active) > len(runner.free_slots()):
                 continue
             dl = self.leases.try_acquire(bid)
             if dl is None:
                 continue
             try:
+                if self.pack_mode == "predicted":
+                    # journaled BEFORE its effect (the merge + the
+                    # repack/admit records below), so resume and
+                    # sibling hosts see WHY the donor drained — and a
+                    # replay needs only the record, never the artifact
+                    self.journal.append({
+                        "ev": "pack_decision", "kind": "repack",
+                        "bucket": bid, "into": runner.bucket_id,
+                        "mode": self.pack_mode,
+                        "observed_occupancy": round(occ, 4),
+                        "predicted_occupancy":
+                            None if pocc is None else round(pocc, 4),
+                        "artifact_sha":
+                            (self.pack_artifact or {}).get("sha"),
+                        "host": self.host})
                 self.journal.append(
                     {"ev": "lease_acquire", "bucket": bid,
                      "host": self.host, "gen": dl.gen,

@@ -135,13 +135,30 @@ class ServeFrontend:
 
     def __init__(self, journal: SweepJournal, host: str,
                  listen: Tuple[str, int], *, slots: int = 4,
-                 poll_us: int = 100_000, lint: str = "off") -> None:
+                 poll_us: int = 100_000, lint: str = "off",
+                 pack_mode: str = "first-fit",
+                 pack_artifact=None) -> None:
         if slots < 1:
             raise ValueError(f"--slots must be >= 1, got {slots}")
         from ..analysis import LINT_MODES
         if lint not in LINT_MODES:
             raise ValueError(
                 f"lint must be one of {LINT_MODES}, got {lint!r}")
+        from ..pack.allocate import validate_pack_mode
+        #: slot placement policy (docs/serving.md "Predictive
+        #: packing"): "first-fit" (the historical default — first
+        #: same-key bucket with a free slot), or "predicted" — join
+        #: the open bucket whose forecast remaining horizon best
+        #: matches the admitted config's own forecast, journaled as a
+        #: pack_decision record before the admit
+        self.pack_mode = validate_pack_mode(pack_mode)
+        self.pack_artifact = None
+        if pack_artifact is not None:
+            if isinstance(pack_artifact, str):
+                from ..pack.predict import load_artifact
+                self.pack_artifact = load_artifact(pack_artifact)
+            else:
+                self.pack_artifact = dict(pack_artifact)
         self.journal = journal
         self.host = host
         self.listen = listen
@@ -255,12 +272,40 @@ class ServeFrontend:
         except SweepConfigError as e:
             raise ServeRejected(str(e)) from None
         bid = slot = None
-        for cand in self._by_key.get(key, []):
-            b = self._buckets[cand]
-            if not b.get("closed") and len(b["used"]) < b["capacity"]:
-                bid = cand
-                slot = min(set(range(b["capacity"])) - b["used"])
-                break
+        cands = [c for c in self._by_key.get(key, [])
+                 if not self._buckets[c].get("closed")
+                 and len(self._buckets[c]["used"])
+                 < self._buckets[c]["capacity"]]
+        if self.pack_mode == "predicted":
+            # predictive placement (docs/serving.md "Predictive
+            # packing"): join the open bucket whose forecast remaining
+            # horizon is CLOSEST to this config's own forecast —
+            # journaled BEFORE its effect (the admit / bucket_open
+            # below), so resume and stealing curators replay the same
+            # placement from the record, never the predictor
+            from ..pack.allocate import best_horizon_bucket
+            from ..pack.predict import predict_supersteps
+            pred = predict_supersteps(cfg, self.pack_artifact)
+            horizon = None
+            if cands:
+                pairs = [(c, self._predicted_horizon(c))
+                         for c in cands]
+                bid = best_horizon_bucket(pred, pairs)
+                horizon = dict(pairs)[bid]
+            self.journal.append({
+                "ev": "pack_decision", "kind": "place",
+                "run_id": cfg.run_id,
+                "bucket": bid if bid is not None
+                else f"sb{self._next_bucket}",
+                "mode": self.pack_mode, "predicted": pred,
+                "horizon": horizon,
+                "artifact_sha":
+                    (self.pack_artifact or {}).get("sha")})
+        elif cands:
+            bid = cands[0]
+        if bid is not None:
+            b = self._buckets[bid]
+            slot = min(set(range(b["capacity"])) - b["used"])
         if bid is None:
             bid = f"sb{self._next_bucket}"
             self._next_bucket += 1
@@ -280,6 +325,26 @@ class ServeFrontend:
             k: v for k, v in rec.items() if k != "ev"}
         self._buckets[bid]["used"].add(slot)
         return cfg.run_id, bid, slot
+
+    def _predicted_horizon(self, bid: str) -> int:
+        """Forecast remaining horizon of an open bucket: the max
+        predicted supersteps over its active (admitted, unsettled)
+        members — 0 when every member has settled, i.e. the bucket is
+        about to quiesce and a short config should join IT rather
+        than pin a long-running fleet's pow2 pad."""
+        from ..pack.predict import predict_supersteps
+        horizon = 0
+        for rid, a in self._admitted.items():
+            if a.get("bucket") != bid or rid in self.results \
+                    or rid in self.failed:
+                continue
+            try:
+                mcfg = RunConfig.from_json(dict(a["config"]), 0)
+            except SweepConfigError:
+                continue
+            horizon = max(horizon, predict_supersteps(
+                mcfg, self.pack_artifact))
+        return horizon
 
     # -- result tailing ----------------------------------------------------
 

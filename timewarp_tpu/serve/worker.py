@@ -496,6 +496,27 @@ class OpenBucketRunner:
                 f"bucket {self.bucket_id!r} has {len(free)} free "
                 f"slot(s) for {len(take)} active world(s) of "
                 f"{donor.bucket_id!r}")
+        # a moved world's state slice carries the DONOR's realized
+        # fault-pad columns (restart ledgers at donor.min_pad width).
+        # The merged fleet rebuilds at the elementwise max of member
+        # needs and OUR min_pad — slices only ever _grow_restart to
+        # that width (pad rows are inert; shrinking would drop live
+        # ledger columns), so a donor wider than the post-merge pad
+        # is refused loudly instead of crashing deep in jax
+        from ..faults.schedule import FaultSchedule
+        scheds = [(m.parse_faults() or FaultSchedule(()))
+                  for m in self.members if m is not None]
+        scheds += [(donor.members[b].parse_faults() or
+                    FaultSchedule(())) for b in take]
+        post = self._fault_pad(scheds) if scheds else self.min_pad
+        if any(d > p for d, p in zip(donor.min_pad, post)):
+            raise ValueError(
+                f"repack {donor.bucket_id!r} -> {self.bucket_id!r} "
+                f"refused: donor's realized fault pad "
+                f"{tuple(donor.min_pad)} exceeds the merged fleet's "
+                f"pad {tuple(post)} — an in-flight restart ledger "
+                "never shrinks (faults/schedule.py); repack the "
+                "narrower bucket into the wider one instead")
         if donor.state is None or donor.engine is None:
             donor._rebuild()
         for slot, b in zip(free, take):

@@ -26,10 +26,17 @@ admission, serve/worker.py). Inside a bucket, worlds differ by:
   ``_scan_pad`` drivers (common.py ``padded_scan``), so every budget
   in a pow2 bucket shares one executable.
 
-The plan is a *pure function of the pack* (dict-insertion order over
-the pack's config order, chunked at ``max_bucket``), so a resumed
-sweep re-derives bucket membership exactly from the journaled pack —
-no plan state needs journaling beyond splits.
+Under ``pack_mode="first-fit"`` (the default) the plan is a *pure
+function of the pack* (dict-insertion order over the pack's config
+order, chunked at ``max_bucket``), so a resumed sweep re-derives
+bucket membership exactly from the journaled pack — no plan state
+needs journaling beyond splits. Under ``pack_mode="predicted"``
+(timewarp_tpu/pack/, docs/sweeps.md "Predictive packing") each shape
+group is reordered best-fit-decreasing by forecast supersteps before
+chunking — the plan is then a pure function of ``(pack, artifact)``,
+and the service journals one ``pack_decision`` record per bucket
+BEFORE any bucket starts, so resume replays the identical plan
+without needing the artifact at all.
 """
 
 from __future__ import annotations
@@ -124,10 +131,18 @@ def _bucket_key(cfg: RunConfig):
             resolve_window(cfg), cfg.controller, cfg.speculate)
 
 
-def plan_buckets(configs, max_bucket: int = 64) -> List[Bucket]:
+def plan_buckets(configs, max_bucket: int = 64, *,
+                 pack_mode: str = "first-fit",
+                 predict=None) -> List[Bucket]:
     """Deterministic shape-bucketing of a pack (module docstring).
-    ``max_bucket`` caps worlds per bucket — oversize groups chunk in
-    pack order."""
+    ``max_bucket`` caps worlds per bucket. ``pack_mode="first-fit"``
+    chunks oversize groups in pack order (byte-identical to the
+    historical planner); ``"predicted"`` reorders each group
+    best-fit-decreasing by ``predict(cfg)`` forecast supersteps
+    (``pack/allocate.predicted_order`` — budget fallback when no
+    predictor is given), equalizing per-bucket quiescence horizons."""
+    from ..pack.allocate import predicted_order, validate_pack_mode
+    validate_pack_mode(pack_mode, "plan_buckets pack_mode")
     if max_bucket < 1:
         raise ValueError(f"max_bucket must be >= 1, got {max_bucket}")
     groups: Dict[tuple, List[RunConfig]] = {}
@@ -135,6 +150,10 @@ def plan_buckets(configs, max_bucket: int = 64) -> List[Bucket]:
         groups.setdefault(_bucket_key(cfg), []).append(cfg)
     buckets: List[Bucket] = []
     for key, cfgs in groups.items():
+        if pack_mode == "predicted":
+            cfgs = predicted_order(
+                cfgs, predict if predict is not None
+                else (lambda c: c.budget))
         for i in range(0, len(cfgs), max_bucket):
             part = tuple(cfgs[i:i + max_bucket])
             buckets.append(Bucket(f"b{len(buckets)}", part, key[3]))

@@ -140,6 +140,19 @@ def _service_args(p: argparse.ArgumentParser) -> None:
                         "explain <journal>/events.jsonl --run-id ID`; "
                         "`sweep status` surfaces per-world event "
                         "counts")
+    p.add_argument("--pack", default="first-fit", dest="pack_mode",
+                   help="bucket packing mode (first-fit | predicted; "
+                        "docs/sweeps.md 'Predictive packing'): "
+                        "predicted reorders each shape group best-fit-"
+                        "decreasing by forecast supersteps and "
+                        "journals the plan as pack_decision records "
+                        "(streamed results are bit-identical either "
+                        "way — the survival law holds per world)")
+    p.add_argument("--pack-artifact", default=None,
+                   help="sha-stamped predictor artifact from "
+                        "`timewarp-tpu pack fit` (--pack predicted "
+                        "falls back to each world's declared budget "
+                        "without one)")
 
 
 def _kw(args) -> dict:
@@ -158,6 +171,8 @@ def _kw(args) -> dict:
                 telemetry=args.telemetry, trace_out=args.trace_out,
                 verify=args.state_verify, record=args.record,
                 host=host, lease_ttl_s=args.lease_ttl_s,
+                pack_mode=args.pack_mode,
+                pack_artifact=args.pack_artifact,
                 # a promised post-sweep --verify arms the flip guard's
                 # other legal detection path (service.py)
                 post_verify=args.verify)

@@ -45,7 +45,7 @@ from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Set
 
 __all__ = ["SweepJournal", "JournalState", "SweepJournalError",
-           "status_fields", "merge_key"]
+           "status_fields", "merge_key", "util_rollup"]
 
 
 def merge_key(rec: Dict[str, Any]):
@@ -126,6 +126,17 @@ class JournalState:
     #: True once a serve_drain record landed: the frontend stopped
     #: admitting; curators exit when every admitted world settles
     draining: bool = False
+    #: bucket_id -> the sweep plan's pack_decision record ({"members",
+    #: "mode", "artifact_sha", ...}, timewarp_tpu/pack/): journaled
+    #: BEFORE any bucket starts when the plan is not a pure function
+    #: of the pack alone (--pack predicted), so resume re-derives the
+    #: identical bucket membership from the journal — never from a
+    #: re-run of the predictor (docs/sweeps.md "Predictive packing").
+    #: Insertion-ordered: the fold preserves plan order.
+    pack_plan: Dict[str, dict] = field(default_factory=dict)
+    #: every pack_decision record (sweep plan form + the serving
+    #: layer's placement/repack choices) — the packing audit trail
+    pack_decisions: List[dict] = field(default_factory=list)
 
     def apply(self, rec: Dict[str, Any]) -> None:
         """Fold ONE journal record into this state — the single fold
@@ -235,6 +246,30 @@ class JournalState:
                 {k: v for k, v in rec.items() if k != "ev"})
         elif ev == "serve_drain":
             self.draining = True
+        elif ev == "pack_decision":
+            d = {k: v for k, v in rec.items() if k != "ev"}
+            self.pack_decisions.append(d)
+            if "members" in d:
+                # the sweep plan form: exactly one per bucket. A
+                # duplicate with identical membership is a resumed
+                # service re-journaling its replayed plan (harmless);
+                # DIFFERENT membership for one bucket id is the
+                # unforgivable state — a resumed sweep would load
+                # checkpoints planned for other worlds
+                prev = self.pack_plan.get(d["bucket"])
+                if prev is not None:
+                    knobs = ("members", "mode", "artifact_sha")
+                    if any(prev.get(k) != d.get(k) for k in knobs):
+                        raise SweepJournalError(
+                            f"bucket {d['bucket']!r} is "
+                            f"double-journaled with DIFFERENT pack "
+                            f"decisions — refusing to pick one:\n"
+                            f"  first:  {prev}\n  second: {d}")
+                    _log.warning("sweep journal: duplicate pack "
+                                 "decision for bucket %r (identical "
+                                 "membership)", d["bucket"])
+                else:
+                    self.pack_plan[d["bucket"]] = d
         elif ev == "dispatch_decision":
             dl = self.decisions.setdefault(rec["bucket"], [])
             d = rec["decision"]
@@ -269,6 +304,7 @@ class JournalState:
                                      for v in self.decisions.values()),
             "spec_rollback": len(self.spec_rollbacks),
             "integrity_violation": len(self.integrity),
+            "pack_decision": len(self.pack_decisions),
         }
 
     def decision_chain(self, bucket_id: str) -> List[dict]:
@@ -481,6 +517,31 @@ class SweepJournal:
                 raise SweepJournalError(
                     f"sweep journal {self.path!r}: {e}") from None
         return st
+
+
+def util_rollup(util: Dict[str, dict]) -> Dict[str, float]:
+    """Fleet-level packing efficiency from the per-bucket
+    ``bucket_util`` records (sweep/runner.py, serve/worker.py): the
+    work-weighted ``budget_efficiency`` (world supersteps over every
+    slot-superstep the batched scans paid for) and ``pad_waste_frac``
+    (pow2 scan-pad supersteps over scan supersteps), across all
+    buckets. THE two numbers the predictive packer is gated on —
+    surfaced on the sweep_hetero/serve_gossip bench lines and
+    promoted to `ledger compare` metrics (obs/regress.py), so a
+    packing regression is a gateable rate regression."""
+    world = scan_total = pad = slot_total = 0.0
+    for u in util.values():
+        s = float(u.get("scan_supersteps", 0) or 0)
+        world += float(u.get("world_supersteps", 0) or 0)
+        scan_total += s
+        slot_total += float(u.get("worlds", 0) or 0) * s
+        pad += float(u.get("pad_waste_frac", 0.0) or 0.0) * s
+    return {
+        "budget_efficiency": round(world / slot_total, 4)
+        if slot_total else 1.0,
+        "pad_waste_frac": round(pad / scan_total, 4)
+        if scan_total else 0.0,
+    }
 
 
 def status_fields(scan: JournalState,

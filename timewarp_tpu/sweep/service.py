@@ -61,7 +61,7 @@ from ..manage.sync import Flag
 from .bucket import Bucket, plan_buckets
 from .journal import SweepJournal, SweepJournalError
 from .runner import BucketRunner
-from .spec import SweepPack
+from .spec import SweepPack, resolve_window
 
 __all__ = ["SweepService", "SweepReport", "SweepKilled",
            "SimulatedTransient", "SimulatedOOM", "InjectPlan"]
@@ -237,7 +237,9 @@ class SweepService:
                  post_verify: bool = False,
                  host: Optional[str] = None,
                  lease_ttl_s: float = 30.0,
-                 peer_poll_us: int = 500_000) -> None:
+                 peer_poll_us: int = 500_000,
+                 pack_mode: str = "first-fit",
+                 pack_artifact: Optional[str] = None) -> None:
         if chunk < 1:
             raise ValueError(f"chunk must be >= 1, got {chunk}")
         if max_retries < 0:
@@ -284,6 +286,22 @@ class SweepService:
         self.bucket_timeout_us = bucket_timeout_us
         self.grace_us = int(grace_us)
         self.max_bucket = max_bucket
+        # predictive packing (timewarp_tpu/pack/, docs/sweeps.md
+        # "Predictive packing"): "predicted" reorders each shape
+        # group best-fit-decreasing by forecast supersteps before
+        # chunking, and journals one pack_decision per bucket BEFORE
+        # any bucket starts — resume replays the journaled plan
+        # bit-identically, artifact or not. "first-fit" is the
+        # historical plan, a pure function of the pack (no journaling
+        # needed). The artifact is the sha-stamped fitted predictor
+        # (`timewarp-tpu pack fit`); without one, forecasts fall back
+        # to each config's budget — honest, never fabricated.
+        from ..pack.allocate import validate_pack_mode
+        self.pack_mode = validate_pack_mode(pack_mode)
+        self.pack_artifact = None
+        if pack_artifact is not None:
+            from ..pack.predict import load_artifact
+            self.pack_artifact = load_artifact(pack_artifact)
         self.lint = lint
         # fleet-scale pre-flight verification (analysis/plan_lint.py,
         # docs/sweeps.md "Pre-flight verification"): the whole pack is
@@ -417,7 +435,7 @@ class SweepService:
 
         queue: deque = deque()
         settled = set(self.done) | set(self.failed)
-        for base in plan_buckets(self.pack.configs, self.max_bucket):
+        for base in self._base_plan(scan):
             for bucket in expand(base):
                 if bucket.bucket_id in scan.bucket_done:
                     continue
@@ -436,6 +454,69 @@ class SweepService:
                         bucket.bucket_id)))
         self._planned = len(queue)
         return queue
+
+    def _base_plan(self, scan) -> List[Bucket]:
+        """The base bucket plan, BEFORE split expansion. Three-way:
+
+        1. the journal already holds ``pack_decision`` plan records —
+           replay them verbatim (membership and order), no artifact
+           needed: the plan is journal state, so resume/steal rebuild
+           the identical buckets even on a host without the predictor
+           file;
+        2. ``pack_mode="predicted"`` on a fresh journal — plan
+           best-fit-decreasing by forecast supersteps
+           (pack/allocate.py) and journal one ``pack_decision`` per
+           bucket before ANY bucket starts;
+        3. first-fit (the default) — the plan is a pure function of
+           the pack (bucket.py docstring); nothing to journal.
+        """
+        if scan.pack_plan:
+            by_id = {c.run_id: c for c in self.pack.configs}
+            covered: set = set()
+            planned: List[Bucket] = []
+            for bid, d in scan.pack_plan.items():
+                missing = [r for r in d["members"] if r not in by_id]
+                if missing:
+                    raise SweepJournalError(
+                        f"journaled pack_decision for bucket {bid!r} "
+                        f"names worlds absent from the pack "
+                        f"({missing}) — the journal belongs to a "
+                        "different pack")
+                cfgs = tuple(by_id[r] for r in d["members"])
+                planned.append(
+                    Bucket(bid, cfgs, resolve_window(cfgs[0])))
+                covered.update(d["members"])
+            if covered != set(by_id):
+                raise SweepJournalError(
+                    "journaled pack_decision records cover "
+                    f"{len(covered)} of {len(by_id)} pack worlds — "
+                    "the plan journal is truncated; refusing to "
+                    "invent placement for the rest")
+            return planned
+        if self.pack_mode == "predicted":
+            if any(e.get("ev") == "bucket_start" for e in scan.events):
+                raise SweepJournalError(
+                    "this journal was planned first-fit (buckets "
+                    "already started, no pack_decision records) — "
+                    "re-bucketing in-flight worlds would resume them "
+                    "from checkpoints planned for other buckets; "
+                    "resume with --pack first-fit")
+            from ..pack.predict import predict_supersteps
+            art = self.pack_artifact
+
+            def predict(c):
+                return predict_supersteps(c, art)
+
+            plan = plan_buckets(self.pack.configs, self.max_bucket,
+                                pack_mode="predicted", predict=predict)
+            for b in plan:
+                self.journal.append({
+                    "ev": "pack_decision", "bucket": b.bucket_id,
+                    "members": list(b.run_ids), "mode": "predicted",
+                    "artifact_sha": (art or {}).get("sha"),
+                    "predicted": [predict(c) for c in b.configs]})
+            return plan
+        return plan_buckets(self.pack.configs, self.max_bucket)
 
     def decisions_for_world(self, run_id: str, scan=None):
         """The journaled dispatch-decision chain governing
